@@ -1,0 +1,19 @@
+"""Prepare the MNIST-784 dataset under data/mnist_784/.
+
+Same entrypoint role as the reference's `download_dataset.py`; falls back to a
+deterministic synthetic MNIST-784 in air-gapped environments (see
+`shallowspeed_tpu/data/mnist.py`).
+"""
+
+import argparse
+
+from shallowspeed_tpu.data.mnist import prepare_mnist
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--save-dir", default="data/mnist_784")
+    p.add_argument("--synthetic", action="store_true",
+                   help="skip the OpenML fetch and generate synthetic data")
+    args = p.parse_args()
+    out = prepare_mnist(args.save_dir, synthetic=True if args.synthetic else None)
+    print(f"dataset ready at {out}")

@@ -1,0 +1,13 @@
+"""shallowspeed_tpu — a TPU-native (JAX/XLA) distributed-training framework.
+
+Re-designs the capability set of juvi21/ShallowSpeed (reference at
+/root/reference) for TPU hardware: jit-compiled jax.numpy ops with
+hand-written VJPs, pure-functional stage-partitioned models, schedules as
+testable pure data driving a pipeline VM, and SPMD parallelism over a 2-D
+(dp, pp) `jax.sharding.Mesh` with XLA collectives (psum / ppermute) instead
+of mpi4py Iallreduce / Send / Recv.
+"""
+
+__version__ = "0.1.0"
+
+from shallowspeed_tpu.ops import functional  # noqa: F401

@@ -1,0 +1,107 @@
+"""Fused training engines — the compiled fast path.
+
+The pipeline VM (`parallel/worker.py`) interprets instruction streams with one
+dispatch per instruction, mirroring the reference's executor
+(`/root/reference/shallowspeed/pipe.py:434-466`). For dp×1 topologies the
+whole batch step can instead be **one** jitted XLA program: `lax.scan` over
+the microbatch stack (grad accumulation, `layers.py:135-136` semantics),
+`lax.psum` of the accumulated grads over the 'dp' mesh axis (replacing the
+interleaved `Iallreduce`/`Waitall`, `pipe.py:302-327` — XLA's latency-hiding
+scheduler overlaps the collective with compute), and the optimizer update —
+zero Python dispatch inside the step, which is what the TPU wants.
+
+Sequential training (`--dp 1 --pp 1`, reference `train.py:62-155` with no
+flags) is the dp=1 special case.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from shallowspeed_tpu.models.mlp import MLPStage, accumulate_grads, zero_grads_like
+
+tree_map = jax.tree_util.tree_map
+
+
+class FusedDPEngine:
+    """One-executable data-parallel trainer over the 'dp' axis of the mesh.
+
+    Equivalent semantics to `PipelineExecutor` with pp=1 and any schedule
+    (they all reduce to: zero, k x (fwd, bwd-acc), allreduce, step on a
+    single stage) — verified against the VM in tests.
+    """
+
+    def __init__(self, stage: MLPStage, optimizer, mesh: Mesh):
+        assert stage.n_stages == 1
+        self.stage = stage
+        self.optimizer = optimizer
+        # accept a (dp, 1) 2-D mesh or a 1-D ('dp',) mesh
+        if mesh.axis_names != ("dp",):
+            devs = mesh.devices.reshape(-1)
+            mesh = Mesh(devs, ("dp",))
+        self.mesh = mesh
+        self.dp = mesh.devices.size
+        self.rep = NamedSharding(mesh, P())
+        self.shard4 = NamedSharding(mesh, P("dp"))  # (dp, n_mu, mubs, d)
+
+        self.params = jax.device_put(stage.init(), self.rep)
+        self.opt_state = jax.device_put(optimizer.init(self.params), self.rep)
+
+        stage_ref = self.stage
+        opt_ref = self.optimizer
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P("dp"), P("dp")),
+                 out_specs=(P(), P()))
+        def _step(params, opt_state, xs, ys):
+            xs, ys = xs[0], ys[0]  # strip the per-device dp block axis
+
+            def body(acc, xy):
+                x, y = xy
+                _, stash = stage_ref.forward(params, x)
+                _, grads = stage_ref.backward(params, stash, y)
+                return accumulate_grads(acc, grads), None
+
+            # the zero init is axis-invariant but the accumulated grads vary
+            # per dp shard — cast the carry to varying for shard_map's typing
+            acc0 = jax.lax.pcast(zero_grads_like(params), ("dp",), to="varying")
+            acc, _ = jax.lax.scan(body, acc0, (xs, ys))
+            total = tree_map(lambda g: jax.lax.psum(g, "dp"), acc)
+            return opt_ref.step(params, total, opt_state)
+
+        @partial(jax.jit)
+        @partial(shard_map, mesh=mesh, in_specs=(P(), P("dp")),
+                 out_specs=P("dp"))
+        def _infer(params, x):
+            return stage_ref.infer(params, x)
+
+        self._step = _step
+        self._infer = _infer
+
+    # ------------------------------------------------------------- steps
+
+    def train_batch(self, batch_id: int, datasets):
+        """datasets: dp per-rank Dataset shards; assembles the
+        (dp, n_mu, mubs, d) stacks and runs the fused step."""
+        stacks = [ds.load_mubatch_stack(batch_id) for ds in datasets]
+        xs = np.stack([s[0] for s in stacks])
+        ys = np.stack([s[1] for s in stacks])
+        xs = jax.device_put(xs, self.shard4)
+        ys = jax.device_put(ys, self.shard4)
+        self.params, self.opt_state = self._step(
+            self.params, self.opt_state, xs, ys)
+
+    def infer(self, x: np.ndarray) -> jax.Array:
+        """Forward on a (rows, 784) batch sharded over dp (rows % dp == 0)."""
+        x = jax.device_put(x, NamedSharding(self.mesh, P("dp")))
+        return self._infer(self.params, x)

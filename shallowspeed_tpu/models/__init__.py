@@ -1,0 +1,7 @@
+from shallowspeed_tpu.models.mlp import (  # noqa: F401
+    MLPStage,
+    accumulate_grads,
+    init_stage_params,
+    stage_layer_sizes,
+    zero_grads_like,
+)

@@ -1,0 +1,194 @@
+"""Stage-partitioned MLP as pure functions over parameter pytrees — the L2 layer.
+
+Capability parity with the reference's Module system + MLP
+(`/root/reference/shallowspeed/layers.py:17-270`), re-designed functionally for
+XLA:
+
+- Parameters are pytrees (`list[dict[str, Array]]`), not mutable `Parameter`
+  objects (`layers.py:17-28`): grads are *returned*, the optimizer step is a
+  pure function, and everything jits.
+- The per-microbatch activation cache dicts (`layers.py:70,86,117,154`) become
+  an explicit immutable **stash** pytree returned by `forward` and consumed by
+  `backward` — the functional equivalent that lets GPipe keep several
+  microbatches in flight, and lets `jax.checkpoint`-style rematerialisation
+  apply if wanted.
+- Deterministic dims-keyed init (`layers.py:104-113`): each Linear's weights
+  are drawn from `MT19937(SeedSequence(in_dims + out_dims * 1337))` on the
+  host, so every stage of every (DP, PP) partitioning reconstructs identical
+  weights — the load-bearing property for parallelism-equivalence tests.
+- Stage slicing with one-dim overlap and last-stage Softmax+MSELoss
+  (`layers.py:236-270`).
+
+The backward contract matches the reference's manual autograd: gradients are
+summed over microbatches (`layers.py:135-136`), the last stage's backward takes
+the *target* (its `MSELoss` head turns it into the first upstream gradient,
+`layers.py:157-163`), and loss scaling is by global batch size.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from numpy.random import MT19937, RandomState, SeedSequence
+
+from shallowspeed_tpu.ops import functional as F
+
+StageParamsT = list[dict[str, jax.Array]]
+
+
+def stage_layer_sizes(sizes: list[int], stage_idx: int, n_stages: int) -> list[int]:
+    """The layer-size slice owned by `stage_idx`, overlapping one boundary dim.
+
+    Reference: `layers.py:242-250` — requires `len(sizes) % n_stages == 0`;
+    each stage takes `stage_size` consecutive sizes plus the next boundary, so
+    interior stages own `stage_size` Linears and the last stage one fewer.
+    """
+    assert len(sizes) % n_stages == 0, (len(sizes), n_stages)
+    stage_size = len(sizes) // n_stages
+    lo = stage_idx * stage_size
+    hi = min(len(sizes), lo + stage_size + 1)
+    return sizes[lo:hi]
+
+
+def init_linear_np(in_dims: int, out_dims: int) -> dict[str, np.ndarray]:
+    """Host-side deterministic init for one Linear, keyed only by its dims.
+
+    Reference: `layers.py:104-113`. Identical weights regardless of how the
+    model is partitioned across stages/replicas.
+    """
+    rs = RandomState(MT19937(SeedSequence(in_dims + out_dims * 1337)))
+    w = (rs.normal(0.0, 1.0, (out_dims, in_dims)).astype(np.float32)
+         / np.sqrt(in_dims)).astype(np.float32)
+    b = np.zeros((1, out_dims), dtype=np.float32)
+    return {"W": w, "b": b}
+
+
+def init_stage_params(
+    sizes: list[int], stage_idx: int = 0, n_stages: int = 1
+) -> StageParamsT:
+    """Parameter pytree for one pipeline stage (host numpy; `jax.device_put`
+    or sharding-aware placement happens at the caller)."""
+    local = stage_layer_sizes(sizes, stage_idx, n_stages)
+    return [init_linear_np(local[i], local[i + 1]) for i in range(len(local) - 1)]
+
+
+def zero_grads_like(params: Any) -> Any:
+    """Fresh zero gradient pytree (replaces `Parameter.grad.fill(0)`,
+    `layers.py:59-61`)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def accumulate_grads(acc: Any, new: Any) -> Any:
+    """Sum-accumulate gradients across microbatches (`layers.py:135-136`)."""
+    return jax.tree_util.tree_map(jnp.add, acc, new)
+
+
+class MLPStage:
+    """One pipeline stage of the partitioned MLP, as static metadata + pure fns.
+
+    Pure-functional re-design of `MLP(Sequential)` (`layers.py:236-270`): the
+    object holds only *static* structure (sizes, flags) so its `forward` /
+    `backward` can be jitted once per stage; all numeric state (params, stash)
+    flows through arguments and return values.
+
+    Interior stage: [Linear+ReLU] * k.
+    Last stage:     [Linear+ReLU] * (k-1), Linear (no act), Softmax, MSELoss
+                    (`layers.py:251-263`). `MSELoss.forward` is the identity
+                    (the loss value is never needed for the gradient,
+                    `layers.py:150-155`), so the stage's forward output is the
+                    softmax probabilities.
+    """
+
+    def __init__(self, sizes: list[int], stage_idx: int, n_stages: int,
+                 batch_size: int):
+        self.sizes = list(sizes)
+        self.stage_idx = stage_idx
+        self.n_stages = n_stages
+        self.batch_size = batch_size  # GLOBAL batch size (`layers.py:237-241`)
+        self.local_sizes = stage_layer_sizes(sizes, stage_idx, n_stages)
+        self.is_first_stage = stage_idx == 0
+        self.is_last_stage = stage_idx == n_stages - 1
+        self.n_linears = len(self.local_sizes) - 1
+        # Buffer-sizing surface used by the pipeline executor
+        # (`layers.py:268-270`).
+        self.in_dim = self.local_sizes[0]
+        self.out_dim = self.local_sizes[-1]
+
+    # -- init ------------------------------------------------------------
+    def init(self) -> StageParamsT:
+        return init_stage_params(self.sizes, self.stage_idx, self.n_stages)
+
+    # -- pure forward/backward (jittable) --------------------------------
+    def forward(self, params: StageParamsT, x: jax.Array):
+        """Returns (out, stash).
+
+        stash structure (static per stage): one entry per Linear —
+        `{"x": input}` plus `{"mask": relu bitmask}` when the Linear has a
+        ReLU — and for the last stage a trailing `{"logits", "probs"}` entry
+        for the Softmax/MSELoss heads. This is the functional analogue of the
+        `_cache[f"input_{mubatch_id}"]` dicts (`layers.py:70,86,117,154`).
+        """
+        stash = []
+        h = x
+        for i, layer in enumerate(params):
+            entry = {"x": h}
+            h = F.linear(h, layer["W"], layer["b"])
+            has_relu = not (self.is_last_stage and i == self.n_linears - 1)
+            if has_relu:
+                entry["mask"] = h > 0
+                h = F.relu(h)
+            stash.append(entry)
+        if self.is_last_stage:
+            logits = h
+            h = F.softmax(logits)
+            stash.append({"logits": logits, "probs": h})
+        return h, stash
+
+    def infer(self, params: StageParamsT, x: jax.Array) -> jax.Array:
+        """Eval-mode forward: no stash (mirrors `Module.eval()` disabling the
+        cache, `layers.py:56-57,69,85,116`)."""
+        out, _ = self.forward(params, x)
+        return out
+
+    def backward(self, params: StageParamsT, stash, dout: jax.Array):
+        """Returns (dx, grads). `grads` matches the `params` pytree structure.
+
+        On the last stage `dout` is the **target** one-hot batch: the MSELoss
+        head converts it into the upstream gradient
+        (`mse_loss_grad(probs, target, global_bs)`, `layers.py:157-163`), then
+        Softmax's VJP recomputes from stashed logits (`layers.py:89-93`).
+        Reversed-layer traversal mirrors `Sequential.backward`
+        (`layers.py:201-213`).
+        """
+        if self.is_last_stage:
+            head = stash[-1]
+            dout = F.mse_loss_grad(head["probs"], dout, self.batch_size)
+            dout = F.softmax_grad(dout, head["logits"])
+        grads: list[dict[str, jax.Array] | None] = [None] * self.n_linears
+        for i in range(self.n_linears - 1, -1, -1):
+            entry = stash[i]
+            if "mask" in entry:
+                dout = F.relu_grad(dout, entry["mask"])
+            dout, dw, db = F.linear_grad(dout, entry["x"], params[i]["W"])
+            grads[i] = {"W": dw, "b": db}
+        return dout, grads
+
+    def loss(self, params: StageParamsT, x: jax.Array, target: jax.Array):
+        """MSE loss value (global-batch-size scaled). Only valid on the last
+        stage of a 1-stage model or fed with last-stage inputs."""
+        out, _ = self.forward(params, x)
+        return F.mse_loss(out, target, self.batch_size)
+
+    def __repr__(self):
+        layers = []
+        for i in range(self.n_linears):
+            act = "relu" if not (self.is_last_stage and i == self.n_linears - 1) else None
+            layers.append(
+                f"Linear({self.local_sizes[i]}->{self.local_sizes[i+1]}, act: {act})"
+            )
+        if self.is_last_stage:
+            layers += ["Softmax()", "MSELoss()"]
+        return f"MLPStage[{self.stage_idx}/{self.n_stages}]({', '.join(layers)})"

@@ -1,0 +1,10 @@
+from shallowspeed_tpu.ops.functional import (  # noqa: F401
+    linear,
+    linear_grad,
+    mse_loss,
+    mse_loss_grad,
+    relu,
+    relu_grad,
+    softmax,
+    softmax_grad,
+)

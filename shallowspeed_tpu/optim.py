@@ -1,0 +1,80 @@
+"""Optimizers as pure pytree transforms.
+
+Capability parity with the reference's stateless SGD
+(`/root/reference/shallowspeed/optimizer.py:4-13`, `param.data -= lr * grad`),
+re-designed functionally: `step(params, grads, state) -> (params, state)` is a
+pure function that jits and shards like any other part of the training step
+(optax-style, but self-contained). Momentum-SGD and Adam are additions beyond
+the reference surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+tree_map = jax.tree_util.tree_map
+
+
+class SGD:
+    """Plain SGD. Reference: `optimizer.py:4-13`."""
+
+    def __init__(self, lr: float):
+        self.lr = lr
+
+    def init(self, params: Any) -> Any:
+        return ()
+
+    def step(self, params: Any, grads: Any, state: Any = ()):
+        new = tree_map(lambda p, g: p - self.lr * g, params, grads)
+        return new, state
+
+
+class MomentumSGD:
+    """SGD with classical momentum (addition beyond the reference)."""
+
+    def __init__(self, lr: float, momentum: float = 0.9):
+        self.lr = lr
+        self.momentum = momentum
+
+    def init(self, params: Any) -> Any:
+        return tree_map(jnp.zeros_like, params)
+
+    def step(self, params: Any, grads: Any, state: Any):
+        vel = tree_map(lambda v, g: self.momentum * v + g, state, grads)
+        new = tree_map(lambda p, v: p - self.lr * v, params, vel)
+        return new, vel
+
+
+class Adam:
+    """Adam (addition; matches the reference's PyTorch-DDP baseline script,
+    `scripts/DDP_PyTorch_MNIST.py`, which trains with torch Adam)."""
+
+    def __init__(self, lr: float, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+
+    def init(self, params: Any) -> Any:
+        return {"m": tree_map(jnp.zeros_like, params),
+                "v": tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def step(self, params: Any, grads: Any, state: Any):
+        t = state["t"] + 1
+        m = tree_map(lambda m_, g: self.b1 * m_ + (1 - self.b1) * g,
+                     state["m"], grads)
+        v = tree_map(lambda v_, g: self.b2 * v_ + (1 - self.b2) * g * g,
+                     state["v"], grads)
+        tf = t.astype(jnp.float32)
+        bc1 = 1 - self.b1 ** tf
+        bc2 = 1 - self.b2 ** tf
+        new = tree_map(
+            lambda p, m_, v_: p - self.lr * (m_ / bc1) /
+            (jnp.sqrt(v_ / bc2) + self.eps),
+            params, m, v)
+        return new, {"m": m, "v": v, "t": t}
+
+
+OPTIMIZERS = {"sgd": SGD, "momentum": MomentumSGD, "adam": Adam}

@@ -1,0 +1,9 @@
+from shallowspeed_tpu.parallel.mesh import make_mesh  # noqa: F401
+from shallowspeed_tpu.parallel.instructions import *  # noqa: F401,F403
+from shallowspeed_tpu.parallel.schedules import (  # noqa: F401
+    GPipeSchedule,
+    InferenceSchedule,
+    NaiveParallelSchedule,
+    PipeDreamSchedule,
+    Schedule,
+)

@@ -1,0 +1,114 @@
+"""The pipeline VM instruction set (ISA) — pure data, no side effects.
+
+Capability parity with the reference's 11 instruction dataclasses
+(`/root/reference/shallowspeed/pipe.py:12-138`). Schedules emit these;
+executors interpret them. Keeping the ISA as plain dataclasses is what makes
+schedules unit-testable with zero devices (SURVEY §4.3) and gives later
+executors (the fused SPMD pipeline) a stable seam.
+
+TPU semantics differences from the reference (documented per instruction):
+- Send/Recv pairs are realised as device-to-device array transfers
+  (`jax.device_put` across stage shardings) from a single controller — the
+  dispatch is asynchronous, so unlike the reference's blocking `MPI.Send`
+  (`pipe.py:41-77` docstrings flag that limitation) the transfer overlaps
+  with subsequent compute dispatch.
+- BackwardGradAllReduce's interleaved per-parameter `Iallreduce`
+  (`pipe.py:108-115`) becomes a single bucketed `lax.psum` over the `dp`
+  mesh axis of the whole accumulated gradient pytree — the bucketing that the
+  reference's own docstring (`pipe.py:309-310`) names as the known
+  improvement; XLA's latency-hiding scheduler overlaps it with compute.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = [
+    "PipeInstr", "ZeroGrad", "OptimizerStep", "BufferPipeInstr",
+    "RecvActivations", "SendActivations", "RecvOutputGrad", "SendInputGrad",
+    "MuBatchPipeInstr", "Forward", "BackwardGradAcc", "BackwardGradAllReduce",
+    "LoadInstruction", "LoadMuBatchInput", "LoadMuBatchTarget",
+]
+
+
+class PipeInstr:
+    """Base of the ISA (`pipe.py:12-13`)."""
+
+
+@dataclass
+class ZeroGrad(PipeInstr):
+    """Reset the gradient accumulator — starts a new accumulation phase
+    (`pipe.py:16-23`)."""
+
+
+@dataclass
+class OptimizerStep(PipeInstr):
+    """Apply the optimizer to (params, accumulated grads) (`pipe.py:26-32`)."""
+
+
+@dataclass
+class BufferPipeInstr(PipeInstr):
+    buffer_id: int
+
+
+@dataclass
+class RecvActivations(BufferPipeInstr):
+    """Receive activations from the previous stage into an input buffer
+    (`pipe.py:40-47`)."""
+
+
+@dataclass
+class SendActivations(BufferPipeInstr):
+    """Send this stage's forward output to the next stage (`pipe.py:50-57`)."""
+
+
+@dataclass
+class RecvOutputGrad(BufferPipeInstr):
+    """Receive d(loss)/d(output) from the next stage into an output buffer
+    (`pipe.py:60-67`)."""
+
+
+@dataclass
+class SendInputGrad(BufferPipeInstr):
+    """Send d(loss)/d(input) to the previous stage (`pipe.py:70-77`)."""
+
+
+@dataclass
+class MuBatchPipeInstr(PipeInstr):
+    buffer_id: int
+    mubatch_id: int
+
+
+@dataclass
+class Forward(MuBatchPipeInstr):
+    """Stage forward on one microbatch; stash activations under mubatch_id
+    (`pipe.py:86-93`)."""
+
+
+@dataclass
+class BackwardGradAcc(MuBatchPipeInstr):
+    """Stage backward on one microbatch; sum-accumulate grads locally
+    (`pipe.py:96-104`)."""
+
+
+@dataclass
+class BackwardGradAllReduce(MuBatchPipeInstr):
+    """Like BackwardGradAcc, then reduce the accumulated grads across the
+    `dp` mesh axis (`pipe.py:107-115`; see module docstring for the psum
+    bucketing semantics)."""
+
+
+@dataclass
+class LoadInstruction(MuBatchPipeInstr):
+    """Base for host-data loads; executors pass the current batch_id
+    (`pipe.py:118-120`, `pipe.py:456-462`)."""
+
+
+@dataclass
+class LoadMuBatchInput(LoadInstruction):
+    """Load microbatch inputs X into an input buffer (`pipe.py:123-129`)."""
+
+
+@dataclass
+class LoadMuBatchTarget(LoadInstruction):
+    """Load microbatch targets y into an output buffer (`pipe.py:132-138`)."""

@@ -1,0 +1,32 @@
+"""Test configuration: run everything on a virtual 8-device CPU platform.
+
+The reference tests multi-stage logic without processes by keeping schedules
+pure data (`/root/reference/tests/test_schedules.py`). We keep that idea and go
+further: with `--xla_force_host_platform_device_count=8` a single pytest
+process hosts a real 8-device `jax.sharding.Mesh`, so DP×PP SPMD paths run
+end-to-end with real XLA collectives — no MPI, no TPU pod needed.
+
+Notes:
+- This environment pre-imports jax config at interpreter startup (PYTHONPATH
+  site hook) with JAX_PLATFORMS=axon, so env vars alone are too late; we must
+  use `jax.config.update` to pin the CPU platform.
+- XLA_FLAGS is read lazily at first backend initialization, which has not
+  happened yet at conftest import time, so forcing the host device count here
+  still works.
+- Numerics tests assume true-f32 matmuls (the reference's NumPy/BLAS
+  semantics); TPU MXU defaults to bf16 passes, so pin highest precision for
+  the test suite.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

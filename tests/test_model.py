@@ -1,0 +1,166 @@
+"""L2 model tests — ports the reference's serial partitioning tests
+(`/root/reference/tests/test_layers.py`) and strengthens them with
+full-model-vs-jax.grad and partitioned-vs-unpartitioned equivalence checks
+(possible because init is deterministic and dims-keyed, `layers.py:104-112`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shallowspeed_tpu.models.mlp import (
+    MLPStage,
+    accumulate_grads,
+    init_stage_params,
+    stage_layer_sizes,
+    zero_grads_like,
+)
+from shallowspeed_tpu.ops import functional as F
+
+SIZES = [784, 128, 127, 126, 125, 124, 123, 10]  # reference `train.py:98`
+RNG = np.random.default_rng(1)
+
+
+def rand(*shape):
+    return jnp.asarray(RNG.normal(size=shape).astype(np.float32))
+
+
+def onehot_batch(n, classes=10):
+    t = np.zeros((n, classes), np.float32)
+    t[np.arange(n), RNG.integers(0, classes, n)] = 1.0
+    return jnp.asarray(t)
+
+
+# ------------------------------------------------------- partitioning
+
+
+def test_stage_layer_sizes_overlap():
+    # 8 sizes over 4 stages -> stage_size 2, one-dim overlap
+    # (reference `layers.py:242-250`).
+    assert stage_layer_sizes(SIZES, 0, 4) == [784, 128, 127]
+    assert stage_layer_sizes(SIZES, 1, 4) == [127, 126, 125]
+    assert stage_layer_sizes(SIZES, 2, 4) == [125, 124, 123]
+    assert stage_layer_sizes(SIZES, 3, 4) == [123, 10]
+
+
+def test_stage_structure_first_last():
+    # Mirrors `test_layers.py:52-70`: layer counts and in/out dims per stage.
+    first = MLPStage(SIZES, 0, 4, batch_size=128)
+    last = MLPStage(SIZES, 3, 4, batch_size=128)
+    assert first.n_linears == 2 and last.n_linears == 1
+    assert first.in_dim == 784 and first.out_dim == 127
+    assert last.in_dim == 123 and last.out_dim == 10
+    assert not first.is_last_stage and last.is_last_stage
+
+
+def test_init_deterministic_and_partition_independent():
+    # Same dims -> same weights, regardless of partitioning
+    # (`layers.py:104-106` "same initial weights no matter if distributed").
+    whole = init_stage_params(SIZES, 0, 1)
+    parts = [init_stage_params(SIZES, s, 4) for s in range(4)]
+    flat = [layer for p in parts for layer in p]
+    assert len(whole) == len(flat) == 7
+    for a, b in zip(whole, flat):
+        np.testing.assert_array_equal(a["W"], b["W"])
+        np.testing.assert_array_equal(a["b"], b["b"])
+    w = np.asarray(whole[0]["W"])
+    assert w.dtype == np.float32
+    assert abs(w.std() - 1 / np.sqrt(784)) < 0.005  # scaled-normal init
+
+
+# ------------------------------------------------------- forward/backward
+
+
+def test_forward_shapes_and_softmax_head():
+    stage = MLPStage(SIZES, 0, 1, batch_size=32)
+    params = stage.init()
+    x = rand(32, 784)
+    out, stash = stage.forward(params, x)
+    assert out.shape == (32, 10)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(32), atol=1e-5)
+    assert len(stash) == 7 + 1  # 7 linears + softmax/loss head
+
+
+def test_backward_accumulation_and_zero():
+    # Grad accumulation across microbatches + zero_grad
+    # (`test_layers.py:7-49`, `layers.py:135-136,59-61`).
+    stage = MLPStage(SIZES, 0, 1, batch_size=8)
+    params = stage.init()
+    acc = zero_grads_like(params)
+    for mu in range(2):
+        x, t = rand(4, 784), onehot_batch(4)
+        _, stash = stage.forward(params, x)
+        _, grads = stage.backward(params, stash, t)
+        acc = accumulate_grads(acc, grads)
+    for layer in acc:
+        assert float(jnp.abs(layer["W"]).sum()) > 0
+        assert layer["W"].dtype == jnp.float32
+    zeroed = zero_grads_like(acc)
+    for layer in zeroed:
+        assert float(jnp.abs(layer["W"]).sum()) == 0
+
+
+def test_manual_backward_matches_jax_grad():
+    """The hand-written stage backward equals jax.grad of the loss — the
+    strongest possible autograd contract (not present in the reference)."""
+    stage = MLPStage(SIZES, 0, 1, batch_size=16)
+    params = stage.init()
+    x, t = rand(16, 784), onehot_batch(16)
+
+    _, stash = stage.forward(params, x)
+    _, manual = stage.backward(params, stash, t)
+
+    auto = jax.grad(lambda p: stage.loss(p, x, t))(params)
+    for m, a in zip(manual, auto):
+        np.testing.assert_allclose(m["W"], a["W"], rtol=2e-3, atol=2e-6)
+        np.testing.assert_allclose(m["b"], a["b"], rtol=2e-3, atol=2e-6)
+
+
+def test_pipelined_stages_equal_monolithic():
+    """Chaining 4 stage forwards/backwards == the 1-stage model, exactly.
+    This is the parallelism-equivalence property the deterministic init is
+    load-bearing for (SURVEY §2 row 11)."""
+    bs = 8
+    mono = MLPStage(SIZES, 0, 1, batch_size=bs)
+    mono_p = mono.init()
+    stages = [MLPStage(SIZES, s, 4, batch_size=bs) for s in range(4)]
+    stage_ps = [s.init() for s in stages]
+
+    x, t = rand(bs, 784), onehot_batch(bs)
+
+    mono_out, mono_stash = mono.forward(mono_p, x)
+    h = x
+    stashes = []
+    for s, p in zip(stages, stage_ps):
+        h, st = s.forward(p, h)
+        stashes.append(st)
+    np.testing.assert_allclose(h, mono_out, rtol=1e-6)
+
+    _, mono_grads = mono.backward(mono_p, mono_stash, t)
+    dout = t
+    pp_grads = []
+    for s, p, st in zip(reversed(stages), reversed(stage_ps), reversed(stashes)):
+        dout, g = s.backward(p, st, dout)
+        pp_grads = g + pp_grads
+    for m, g in zip(mono_grads, pp_grads):
+        np.testing.assert_allclose(m["W"], g["W"], rtol=1e-5, atol=1e-7)
+
+
+def test_infer_mode_no_stash_needed():
+    stage = MLPStage(SIZES, 0, 1, batch_size=4)
+    out = stage.infer(stage.init(), rand(4, 784))
+    assert out.shape == (4, 10)
+
+
+def test_stage_fns_jit():
+    stage = MLPStage(SIZES, 3, 4, batch_size=8)
+    params = stage.init()
+    x, t = rand(8, 123), onehot_batch(8)
+    fwd = jax.jit(stage.forward)
+    out, stash = fwd(params, x)
+    bwd = jax.jit(stage.backward)
+    dx, grads = bwd(params, stash, t)
+    ref_out, ref_stash = stage.forward(params, x)
+    ref_dx, _ = stage.backward(params, ref_stash, t)
+    np.testing.assert_allclose(out, ref_out, rtol=1e-6)
+    np.testing.assert_allclose(dx, ref_dx, rtol=1e-5, atol=1e-7)

@@ -1,0 +1,195 @@
+"""CLI training driver — L6.
+
+Same surface as the reference (`/root/reference/train.py:62-155`):
+`python train.py [--dp N] [--pp M] [--schedule naive|gpipe|pipedream]` — but
+no `mpirun`: one controller process sees every TPU device through a
+(dp, pp) `jax.sharding.Mesh` (`train.py:87-94`'s communicator splits become
+mesh axes). Extra flags (epochs, batch size, engine, ...) replace the
+reference's module-level constants (`train.py:56-59`) without changing the
+defaults.
+
+Engines:
+- `fused` (pp=1 only): the whole batch step is one jitted XLA program
+  (`shallowspeed_tpu/engine.py`).
+- `vm`: the instruction-stream pipeline VM (`shallowspeed_tpu/parallel/
+  worker.py`), required for pp>1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from pathlib import Path
+
+import numpy as np
+
+EPOCHS = 20           # reference `train.py:56`
+GLOBAL_BATCH_SIZE = 128  # reference `train.py:58`
+N_MUBATCHES = 4       # reference `train.py:59`
+LAYER_SIZES = [784, 128, 127, 126, 125, 124, 123, 10]  # reference `train.py:98`
+LR = 0.006            # reference `train.py:107`
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dp", type=int, default=1,
+                   help="Degree of data parallelism (=number of full model replicas)")
+    p.add_argument("--pp", type=int, default=1, help="Number of pipeline stages")
+    p.add_argument("--schedule", type=str,
+                   choices=["pipedream", "gpipe", "naive"], default="naive")
+    p.add_argument("--engine", type=str, choices=["auto", "vm", "fused"],
+                   default="auto")
+    p.add_argument("--epochs", type=int, default=EPOCHS)
+    p.add_argument("--batch-size", type=int, default=GLOBAL_BATCH_SIZE)
+    p.add_argument("--mubatches", type=int, default=N_MUBATCHES)
+    p.add_argument("--lr", type=float, default=LR)
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "momentum", "adam"])
+    p.add_argument("--data-dir", type=str, default="data/mnist_784")
+    p.add_argument("--max-batches", type=int, default=0,
+                   help="limit batches per epoch (0 = all); for smoke tests")
+    p.add_argument("--platform", type=str, default=None,
+                   choices=["cpu", "tpu"],
+                   help="force a JAX platform (this environment pins "
+                        "JAX_PLATFORMS at interpreter startup, so a flag — "
+                        "not an env var — is needed to simulate meshes on CPU)")
+    p.add_argument("--host-devices", type=int, default=0,
+                   help="with --platform cpu: number of virtual host devices "
+                        "for mesh simulation (XLA --xla_force_host_platform_"
+                        "device_count)")
+    return p.parse_args(argv)
+
+
+def configure_platform(args):
+    """Must run before the first JAX backend initialization."""
+    import os
+
+    if args.host_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.host_devices}").strip()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+
+def build(args):
+    import jax
+
+    from shallowspeed_tpu.data.dataset import Dataset
+    from shallowspeed_tpu.data.mnist import ensure_mnist
+    from shallowspeed_tpu.engine import FusedDPEngine
+    from shallowspeed_tpu.models.mlp import MLPStage
+    from shallowspeed_tpu.optim import OPTIMIZERS
+    from shallowspeed_tpu.parallel.mesh import make_mesh
+    from shallowspeed_tpu.parallel.worker import PipelineExecutor
+
+    dp, pp = args.dp, args.pp
+    assert dp >= 1 and pp >= 1
+    assert args.batch_size % dp == 0, "Batch size must be divisible by DP"
+    n_devices = len(jax.devices())
+    if dp * pp > n_devices:
+        raise SystemExit(
+            f"requested dp*pp={dp * pp} devices but only {n_devices} present")
+
+    mesh = make_mesh(dp, pp)
+    optimizer = OPTIMIZERS[args.optimizer](lr=args.lr)
+
+    data_dir = ensure_mnist(Path(args.data_dir))
+    local_bs = args.batch_size // dp
+    assert local_bs % args.mubatches == 0, (
+        f"local batch {local_bs} must be divisible by --mubatches "
+        f"{args.mubatches}")
+    mubatch_size = local_bs // args.mubatches
+    train_ds = [Dataset(data_dir, args.batch_size, mubatch_size).load(r, dp)
+                for r in range(dp)]
+    # Validation: whole local batch as one microbatch (reference
+    # `train.py:122-128` uses mubatch_size == global batch, 1 μbatch).
+    val_ds = [Dataset(data_dir, args.batch_size, local_bs, validation=True)
+              .load(r, dp) for r in range(dp)]
+
+    use_fused = args.engine == "fused" or (args.engine == "auto" and pp == 1)
+    if use_fused and pp != 1:
+        raise SystemExit("--engine fused requires --pp 1")
+
+    if use_fused:
+        stage = MLPStage(LAYER_SIZES, 0, 1, batch_size=args.batch_size)
+        engine = FusedDPEngine(stage, optimizer, mesh)
+    else:
+        stages = [MLPStage(LAYER_SIZES, s, pp, batch_size=args.batch_size)
+                  for s in range(pp)]
+        engine = PipelineExecutor(mesh, stages, optimizer)
+    return engine, train_ds, val_ds
+
+
+def compute_accuracy(engine, val_ds) -> float:
+    """Reference `compute_accuracy` (`train.py:21-47`): argmax of the
+    last-stage output vs the one-hot target, streamed over val batches."""
+    from shallowspeed_tpu.engine import FusedDPEngine
+    from shallowspeed_tpu.parallel.schedules import InferenceSchedule
+
+    correct = total = 0
+    for batch_id in range(val_ds[0].get_num_batches()):
+        targets = np.concatenate(
+            [ds.load_micro_batch_target(batch_id, 0) for ds in val_ds])
+        if isinstance(engine, FusedDPEngine):
+            x = np.concatenate(
+                [ds.load_micro_batch_input(batch_id, 0) for ds in val_ds])
+            out = np.asarray(engine.infer(x))
+        else:
+            out = np.asarray(
+                engine.infer_batch(InferenceSchedule, 1, batch_id, val_ds))
+        pred = out.argmax(axis=-1)
+        correct += int((pred == targets.argmax(axis=-1)).sum())
+        total += len(pred)
+    return correct / total
+
+
+def train(args) -> float:
+    from shallowspeed_tpu.engine import FusedDPEngine
+    from shallowspeed_tpu.parallel.schedules import (
+        GPipeSchedule, NaiveParallelSchedule, PipeDreamSchedule)
+    from shallowspeed_tpu.utils import assert_replicas_in_sync, get_model_hash, rprint
+
+    schedule_cls = {
+        "naive": NaiveParallelSchedule,
+        "gpipe": GPipeSchedule,
+        "pipedream": PipeDreamSchedule,
+    }[args.schedule]
+
+    engine, train_ds, val_ds = build(args)
+    n_batches = train_ds[0].get_num_batches()
+    if args.max_batches:
+        n_batches = min(n_batches, args.max_batches)
+
+    start = time.time()
+    accuracy = 0.0
+    for epoch in range(args.epochs):
+        accuracy = compute_accuracy(engine, val_ds)
+        rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
+               f"Accuracy: {accuracy * 100:.2f}%")
+        for batch_id in range(n_batches):
+            if isinstance(engine, FusedDPEngine):
+                engine.train_batch(batch_id, train_ds)
+            else:
+                engine.train_batch(schedule_cls, args.mubatches, batch_id,
+                                   train_ds)
+
+    accuracy = compute_accuracy(engine, val_ds)
+    rprint(f"Epoch: {args.epochs}, Time Spent: {time.time() - start:.2f}s, "
+           f"Accuracy: {accuracy * 100:.2f}%")
+
+    # Sanity check: DP replicas hold bit-identical weights (reference
+    # `train.py:154-155`, `utils.py:27-31`).
+    params = engine.params
+    assert_replicas_in_sync(params)
+    rprint(f"model hash: {get_model_hash(params)}")
+    return accuracy
+
+
+if __name__ == "__main__":
+    _args = parse_args()
+    configure_platform(_args)
+    train(_args)
