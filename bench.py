@@ -1,0 +1,150 @@
+"""Benchmark: MNIST-MLP training throughput on the reference workload.
+
+Workload = the reference's exact training config (`/root/reference/
+train.py:56-59,98,107`): MLP [784,128,127,126,125,124,123,10], global batch
+128, 4 microbatches, SGD lr=0.006, MSE-on-softmax.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is
+*measured in-process*: a pure-NumPy training step with identical math
+(forward, hand-written backward, microbatch grad accumulation, SGD) — the
+same substrate the reference dispatches to (NumPy + system BLAS,
+`README.md:23`). `vs_baseline` = our samples/sec divided by NumPy's on this
+host.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+LAYER_SIZES = [784, 128, 127, 126, 125, 124, 123, 10]
+GBS = 128
+N_MU = 4
+LR = 0.006
+BENCH_BATCHES = 464   # full-epoch batch count of the 59,392-sample train set
+EPOCHS = 20           # the reference's full run (`train.py:56`)
+
+
+# --------------------------------------------------------- numpy baseline
+
+
+def numpy_baseline_step_fn():
+    """Reference-equivalent pure-NumPy training step (measured, not copied:
+    same math as shallowspeed_tpu.ops.functional on the NumPy substrate)."""
+    from shallowspeed_tpu.models.mlp import init_stage_params
+
+    params = [{k: np.asarray(v) for k, v in layer.items()}
+              for layer in init_stage_params(LAYER_SIZES)]
+    n = len(params)
+
+    def step(xs, ys):  # xs: (N_MU, mubs, 784)
+        grads = [{"W": np.zeros_like(p["W"]), "b": np.zeros_like(p["b"])}
+                 for p in params]
+        for mu in range(N_MU):
+            x, t = xs[mu], ys[mu]
+            acts = [x]
+            masks = []
+            h = x
+            for i, p in enumerate(params):
+                z = h @ p["W"].T + p["b"]
+                if i < n - 1:
+                    masks.append(z > 0)
+                    h = np.maximum(z, 0.0)
+                else:
+                    h = z
+                acts.append(h)
+            e = np.exp(h - h.max())
+            probs = e / (e.sum(axis=1, keepdims=True) + 1e-7)
+            dout = -2.0 * (t - probs) / GBS
+            g = probs * dout
+            dout = g - probs * g.sum(axis=-1, keepdims=True)
+            for i in range(n - 1, -1, -1):
+                if i < n - 1:
+                    dout = dout * masks[i]
+                grads[i]["W"] += dout.T @ acts[i]
+                grads[i]["b"] += dout.sum(axis=0, keepdims=True)
+                dout = dout @ params[i]["W"]
+        for p, g in zip(params, grads):
+            p["W"] -= LR * g["W"]
+            p["b"] -= LR * g["b"]
+
+    return step
+
+
+def bench_numpy(xs, ys, n_batches=60) -> float:
+    """Sustained NumPy samples/sec, measured over a subset and scaled (the
+    full 20-epoch run would take minutes)."""
+    step = numpy_baseline_step_fn()
+    for _ in range(3):
+        step(xs, ys)
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        step(xs, ys)
+    dt = time.perf_counter() - t0
+    return n_batches * GBS / dt
+
+
+# ------------------------------------------------------------ jax/tpu side
+
+
+def bench_tpu(xs, ys, n_batches=BENCH_BATCHES) -> float:
+    """Epoch-fused throughput: batches staged HBM-resident, one dispatch per
+    `train_epoch` — the TPU-native execution model (bench includes the
+    amortised staging cost)."""
+    import jax
+
+    from shallowspeed_tpu.engine import FusedDPEngine
+    from shallowspeed_tpu.models.mlp import MLPStage
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(1, 1)
+    stage = MLPStage(LAYER_SIZES, 0, 1, batch_size=GBS)
+    eng = FusedDPEngine(stage, SGD(LR), mesh)
+
+    class _DS:  # minimal adapter over pre-generated host arrays
+        def get_num_batches(self):
+            return n_batches
+
+        def load_mubatch_stack(self, batch_id):
+            return xs, ys
+
+    eng.train_epoch(eng.stage_epoch([_DS()]))  # compile warmup (excluded)
+    jax.block_until_ready(eng.params)
+
+    # Timed region = the full training run as a user experiences it:
+    # host->device staging of the whole dataset + EPOCHS fused epochs.
+    t0 = time.perf_counter()
+    staged = eng.stage_epoch([_DS()])
+    for _ in range(EPOCHS):
+        eng.train_epoch(staged)
+    jax.block_until_ready(eng.params)
+    dt = time.perf_counter() - t0
+    return (EPOCHS * n_batches) * GBS / dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(N_MU, GBS // N_MU, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, GBS)
+    ys = np.zeros((GBS, 10), np.float32)
+    ys[np.arange(GBS), labels] = 1.0
+    ys = ys.reshape(N_MU, GBS // N_MU, 10)
+
+    tpu_sps = bench_tpu(xs, ys)
+    np_sps = bench_numpy(xs, ys)
+
+    print(json.dumps({
+        "metric": "mnist_mlp_train_throughput",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(tpu_sps / np_sps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,14 +59,13 @@ class FusedDPEngine:
         stage_ref = self.stage
         opt_ref = self.optimizer
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        @partial(shard_map, mesh=mesh,
-                 in_specs=(P(), P(), P("dp"), P("dp")),
-                 out_specs=(P(), P()))
-        def _step(params, opt_state, xs, ys):
-            xs, ys = xs[0], ys[0]  # strip the per-device dp block axis
+        def local_step(params, opt_state, x_mu, y_mu):
+            """Per-device batch step on (n_mu, mubs, d) microbatch stacks:
+            grad-accumulating scan over microbatches (`layers.py:135-136`
+            semantics), one bucketed psum over 'dp' (`pipe.py:302-327`
+            equivalent), optimizer update. Shared by _step and _epoch."""
 
-            def body(acc, xy):
+            def mu_body(acc, xy):
                 x, y = xy
                 _, stash = stage_ref.forward(params, x)
                 _, grads = stage_ref.backward(params, stash, y)
@@ -75,9 +74,16 @@ class FusedDPEngine:
             # the zero init is axis-invariant but the accumulated grads vary
             # per dp shard — cast the carry to varying for shard_map's typing
             acc0 = jax.lax.pcast(zero_grads_like(params), ("dp",), to="varying")
-            acc, _ = jax.lax.scan(body, acc0, (xs, ys))
+            acc, _ = jax.lax.scan(mu_body, acc0, (x_mu, y_mu))
             total = tree_map(lambda g: jax.lax.psum(g, "dp"), acc)
             return opt_ref.step(params, total, opt_state)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P("dp"), P("dp")),
+                 out_specs=(P(), P()))
+        def _step(params, opt_state, xs, ys):
+            return local_step(params, opt_state, xs[0], ys[0])
 
         @partial(jax.jit)
         @partial(shard_map, mesh=mesh, in_specs=(P(), P("dp")),
@@ -85,8 +91,26 @@ class FusedDPEngine:
         def _infer(params, x):
             return stage_ref.infer(params, x)
 
+        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P(None, "dp"), P(None, "dp")),
+                 out_specs=(P(), P()))
+        def _epoch(params, opt_state, xs, ys):
+            # xs: (n_batches, dp, n_mu, mubs, d) — whole epoch device-resident,
+            # one dispatch; HBM-residency is the TPU answer to the reference's
+            # per-microbatch host loads (`dataset.py:66-80`).
+            def batch_body(carry, xy):
+                p, o = carry
+                x, y = xy
+                return local_step(p, o, x[0], y[0]), None
+
+            (params, opt_state), _ = jax.lax.scan(
+                batch_body, (params, opt_state), (xs, ys))
+            return params, opt_state
+
         self._step = _step
         self._infer = _infer
+        self._epoch = _epoch
 
     # ------------------------------------------------------------- steps
 
@@ -105,3 +129,20 @@ class FusedDPEngine:
         """Forward on a (rows, 784) batch sharded over dp (rows % dp == 0)."""
         x = jax.device_put(x, NamedSharding(self.mesh, P("dp")))
         return self._infer(self.params, x)
+
+    # ------------------------------------------------------ epoch staging
+
+    def stage_epoch(self, datasets, n_batches: int | None = None):
+        """Device-put the whole epoch once: returns (xs, ys) of shape
+        (n_batches, dp, n_mu, mubs, d), sharded over 'dp' on axis 1."""
+        from shallowspeed_tpu.data.dataset import stack_epoch
+
+        xs, ys = stack_epoch(datasets, n_batches)
+        shard = NamedSharding(self.mesh, P(None, "dp"))
+        return jax.device_put(xs, shard), jax.device_put(ys, shard)
+
+    def train_epoch(self, staged):
+        """One dispatch for a full epoch over pre-staged device data."""
+        xs, ys = staged
+        self.params, self.opt_state = self._epoch(
+            self.params, self.opt_state, xs, ys)

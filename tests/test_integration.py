@@ -115,6 +115,19 @@ def test_fused_sequential_learns(data_dir):
     assert after > 0.5
 
 
+def test_fused_epoch_matches_batch_sequence(data_dir):
+    """train_epoch (scan over batches, one dispatch) must equal the same
+    batches trained via train_batch — the default `python train.py` path."""
+    a = train_fused(data_dir, dp=2, n_batches=4)
+    mesh = make_mesh(2, 1)
+    stage = MLPStage(SIZES, 0, 1, batch_size=GBS)
+    b = FusedDPEngine(stage, SGD(LR), mesh)
+    ds = make_datasets(data_dir, 2)
+    b.train_epoch(b.stage_epoch(ds, 4))
+    for la, lb in zip(flat_params(a), flat_params(b)):
+        np.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-7)
+
+
 def test_vm_pp1_matches_fused(data_dir):
     fused = train_fused(data_dir, dp=1)
     vm = train_vm(data_dir, dp=1, pp=1, schedule_cls=NaiveParallelSchedule)

@@ -37,8 +37,10 @@ def parse_args(argv=None):
     p.add_argument("--pp", type=int, default=1, help="Number of pipeline stages")
     p.add_argument("--schedule", type=str,
                    choices=["pipedream", "gpipe", "naive"], default="naive")
-    p.add_argument("--engine", type=str, choices=["auto", "vm", "fused"],
-                   default="auto")
+    p.add_argument("--engine", type=str,
+                   choices=["auto", "vm", "fused", "spmd"], default="auto",
+                   help="auto: fused for pp=1, spmd (compiled GPipe) for "
+                        "pp>1 with --schedule gpipe, else the instruction VM")
     p.add_argument("--epochs", type=int, default=EPOCHS)
     p.add_argument("--batch-size", type=int, default=GLOBAL_BATCH_SIZE)
     p.add_argument("--mubatches", type=int, default=N_MUBATCHES)
@@ -110,13 +112,25 @@ def build(args):
     val_ds = [Dataset(data_dir, args.batch_size, local_bs, validation=True)
               .load(r, dp) for r in range(dp)]
 
-    use_fused = args.engine == "fused" or (args.engine == "auto" and pp == 1)
-    if use_fused and pp != 1:
-        raise SystemExit("--engine fused requires --pp 1")
+    from shallowspeed_tpu.parallel.spmd_pipeline import SPMDPipelineEngine
 
-    if use_fused:
+    engine_kind = args.engine
+    if engine_kind == "auto":
+        engine_kind = ("fused" if pp == 1
+                       else "spmd" if args.schedule == "gpipe" else "vm")
+    if engine_kind == "fused" and pp != 1:
+        raise SystemExit("--engine fused requires --pp 1")
+    if engine_kind == "spmd" and args.schedule != "gpipe":
+        raise SystemExit("--engine spmd implements the gpipe schedule; use "
+                         "--schedule gpipe (or --engine vm)")
+
+    if engine_kind == "fused":
         stage = MLPStage(LAYER_SIZES, 0, 1, batch_size=args.batch_size)
         engine = FusedDPEngine(stage, optimizer, mesh)
+    elif engine_kind == "spmd":
+        engine = SPMDPipelineEngine(LAYER_SIZES, optimizer, mesh,
+                                    args.mubatches, mubatch_size,
+                                    args.batch_size)
     else:
         stages = [MLPStage(LAYER_SIZES, s, pp, batch_size=args.batch_size)
                   for s in range(pp)]
@@ -127,18 +141,17 @@ def build(args):
 def compute_accuracy(engine, val_ds) -> float:
     """Reference `compute_accuracy` (`train.py:21-47`): argmax of the
     last-stage output vs the one-hot target, streamed over val batches."""
-    from shallowspeed_tpu.engine import FusedDPEngine
     from shallowspeed_tpu.parallel.schedules import InferenceSchedule
 
     correct = total = 0
     for batch_id in range(val_ds[0].get_num_batches()):
         targets = np.concatenate(
             [ds.load_micro_batch_target(batch_id, 0) for ds in val_ds])
-        if isinstance(engine, FusedDPEngine):
+        if hasattr(engine, "infer"):  # fused / spmd engines
             x = np.concatenate(
                 [ds.load_micro_batch_input(batch_id, 0) for ds in val_ds])
             out = np.asarray(engine.infer(x))
-        else:
+        else:  # pipeline VM
             out = np.asarray(
                 engine.infer_batch(InferenceSchedule, 1, batch_id, val_ds))
         pred = out.argmax(axis=-1)
@@ -148,7 +161,6 @@ def compute_accuracy(engine, val_ds) -> float:
 
 
 def train(args) -> float:
-    from shallowspeed_tpu.engine import FusedDPEngine
     from shallowspeed_tpu.parallel.schedules import (
         GPipeSchedule, NaiveParallelSchedule, PipeDreamSchedule)
     from shallowspeed_tpu.utils import assert_replicas_in_sync, get_model_hash, rprint
@@ -164,16 +176,21 @@ def train(args) -> float:
     if args.max_batches:
         n_batches = min(n_batches, args.max_batches)
 
+    # Fused engines: stage the epoch's batches on device once (HBM-resident)
+    # and run each epoch as a single dispatch.
+    staged = (engine.stage_epoch(train_ds, n_batches)
+              if hasattr(engine, "train_epoch") else None)
+
     start = time.time()
     accuracy = 0.0
     for epoch in range(args.epochs):
         accuracy = compute_accuracy(engine, val_ds)
         rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
                f"Accuracy: {accuracy * 100:.2f}%")
-        for batch_id in range(n_batches):
-            if isinstance(engine, FusedDPEngine):
-                engine.train_batch(batch_id, train_ds)
-            else:
+        if staged is not None:
+            engine.train_epoch(staged)
+        else:
+            for batch_id in range(n_batches):
                 engine.train_batch(schedule_cls, args.mubatches, batch_id,
                                    train_ds)
 
