@@ -1,0 +1,16 @@
+"""L0 data layer: dataset sharding + MNIST preparation.
+
+Reference: `/root/reference/shallowspeed/dataset.py` and
+`/root/reference/download_dataset.py`.
+"""
+
+from shallowspeed_tpu.data.dataset import Dataset, stack_epoch
+from shallowspeed_tpu.data.mnist import ensure_mnist, prepare_mnist, synthesize_mnist
+
+__all__ = [
+    "Dataset",
+    "stack_epoch",
+    "ensure_mnist",
+    "prepare_mnist",
+    "synthesize_mnist",
+]

@@ -1,0 +1,105 @@
+"""MNIST-784 preparation — the `download_dataset.py` capability.
+
+Reference: `/root/reference/download_dataset.py:9-23` fetches MNIST-784 from
+OpenML, normalizes (`x /= 255; x -= mean`), one-hot-encodes targets, splits
+85/15 train/val, and writes files the `Dataset` loader reads back.
+
+This environment is air-gapped, so the OpenML fetch is attempted only when
+explicitly allowed and falls back to a **deterministic synthetic MNIST-784**:
+10 fixed class prototypes + Gaussian noise, normalized to the same scale as
+the real data. The synthetic task is linearly-separable-ish so training
+accuracy is a meaningful signal in tests (SURVEY §4).
+
+Files written (npy instead of parquet — no pandas/pyarrow dependency, same
+role as `x_{train,val}.parquet` + `y_{train,val}.npy`):
+    x_train.npy  (n_train, 784) float32
+    y_train.npy  (n_train, 10)  float32 one-hot
+    x_val.npy    (n_val, 784)   float32
+    y_val.npy    (n_val, 10)    float32 one-hot
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+FILES = ("x_train.npy", "y_train.npy", "x_val.npy", "y_val.npy")
+VAL_FRACTION = 0.15  # reference `download_dataset.py:18` test_size=0.15
+
+
+def synthesize_mnist(n_samples: int = 70000) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic synthetic MNIST-784: (x (n,784) f32, y (n,10) one-hot f32).
+
+    Class prototypes are fixed by a hard-coded seed, so two calls with the
+    same `n_samples` produce bit-identical arrays (required by the dataset
+    equivalence tests, which rebuild shards independently per DP layout).
+    """
+    rng = np.random.default_rng(20240202)
+    prototypes = rng.normal(0.0, 0.35, (10, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, n_samples)
+    noise = rng.normal(0.0, 0.25, (n_samples, 784)).astype(np.float32)
+    x = prototypes[labels] + noise
+    # match the real data's normalization envelope (x/255 - mean ≈ zero-mean,
+    # unit-ish scale after the prototypes' spread)
+    x = (x - x.mean(axis=0, keepdims=True)).astype(np.float32)
+    y = np.zeros((n_samples, 10), np.float32)
+    y[np.arange(n_samples), labels] = 1.0
+    return x, y
+
+
+def _fetch_openml() -> tuple[np.ndarray, np.ndarray]:
+    """Real MNIST-784 via sklearn (reference `download_dataset.py:9-16`).
+    Raises on any failure (air-gapped hosts) — caller falls back."""
+    from sklearn.datasets import fetch_openml  # type: ignore
+
+    mnist = fetch_openml("mnist_784", version=1, as_frame=False)
+    x = np.asarray(mnist.data, np.float32) / 255.0
+    x -= x.mean(axis=0, keepdims=True)
+    labels = np.asarray(mnist.target, int)
+    y = np.zeros((len(labels), 10), np.float32)
+    y[np.arange(len(labels)), labels] = 1.0
+    return x, y
+
+
+def prepare_mnist(save_dir, synthetic: bool | None = None,
+                  n_samples: int = 70000) -> Path:
+    """Write the four dataset files under `save_dir` and return it.
+
+    synthetic=True  → always synthesize;
+    synthetic=None  → try OpenML, fall back to synthetic (zero-egress hosts);
+    synthetic=False → OpenML only (raises offline).
+    """
+    save_dir = Path(save_dir)
+    save_dir.mkdir(parents=True, exist_ok=True)
+
+    if synthetic:
+        x, y = synthesize_mnist(n_samples)
+    else:
+        try:
+            x, y = _fetch_openml()
+        except Exception:
+            if synthetic is False:
+                raise
+            x, y = synthesize_mnist(n_samples)
+
+    n = len(x)
+    n_val = int(n * VAL_FRACTION)
+    n_train = n - n_val
+    # deterministic shuffle before the split (reference uses
+    # train_test_split(random_state=42), `download_dataset.py:18`)
+    perm = np.random.default_rng(42).permutation(n)
+    x, y = x[perm], y[perm]
+    np.save(save_dir / "x_train.npy", x[:n_train])
+    np.save(save_dir / "y_train.npy", y[:n_train])
+    np.save(save_dir / "x_val.npy", x[n_train:])
+    np.save(save_dir / "y_val.npy", y[n_train:])
+    return save_dir
+
+
+def ensure_mnist(save_dir) -> Path:
+    """Idempotent prepare: reuse existing files, else create them."""
+    save_dir = Path(save_dir)
+    if all((save_dir / f).exists() for f in FILES):
+        return save_dir
+    return prepare_mnist(save_dir)

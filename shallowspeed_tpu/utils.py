@@ -44,20 +44,16 @@ def assert_replicas_in_sync(params: Any) -> None:
     for leaf in jax.tree_util.tree_leaves(params):
         if not isinstance(leaf, jax.Array):
             continue
-        leaf_hashes = []
+        # Group shards by the logical index they hold: replicas of the same
+        # slice (e.g. dp-replicated copies of a pp shard) must be identical.
+        by_slice: dict[tuple, set[str]] = {}
         for shard in leaf.addressable_shards:
+            key = tuple((s.start, s.stop, s.step) for s in shard.index)
             arr = np.asarray(shard.data)
-            leaf_hashes.append(
-                hashlib.sha1(np.ascontiguousarray(arr).tobytes()).hexdigest())
-        # all shards holding the same logical slice must agree; for fully
-        # replicated leaves every shard is the same slice
-        if len(set(leaf_hashes)) > 1 and _is_fully_replicated(leaf):
-            raise AssertionError(
-                f"DP replicas out of sync for leaf {leaf.shape}: {leaf_hashes}")
-
-
-def _is_fully_replicated(arr: jax.Array) -> bool:
-    try:
-        return arr.is_fully_replicated
-    except AttributeError:  # older jax
-        return False
+            h = hashlib.sha1(np.ascontiguousarray(arr).tobytes()).hexdigest()
+            by_slice.setdefault(key, set()).add(h)
+        for key, hashes in by_slice.items():
+            if len(hashes) > 1:
+                raise AssertionError(
+                    f"DP replicas out of sync for leaf {leaf.shape} slice "
+                    f"{key}: {sorted(hashes)}")
