@@ -1,0 +1,151 @@
+"""Self-contained PyTorch DP baseline — the cross-framework reference check.
+
+Role parity with `/root/reference/scripts/DDP_PyTorch_MNIST.py:23-167`: an
+*independent* implementation (PyTorch, not this framework) of data-parallel
+MNIST-MLP training whose result is compared against serial training by
+absolute weight divergence — the strongest equivalence check in the reference
+repo (`DDP_PyTorch_MNIST.py:159-167`).
+
+Differences, by design:
+- No mpi4py on this host: DP ranks are simulated in-process. Each rank holds
+  a model replica and computes grads on its strided batch shard; grads are
+  then all-reduce-averaged across ranks (the explicit equivalent of the
+  reference's blocking per-param `Allreduce` + loss/comm.size rescale,
+  `DDP_PyTorch_MNIST.py:113,119-122`) and every replica takes the same Adam
+  step. Replicas staying bit-identical is asserted every epoch (the
+  reference's end-of-run sync check).
+- The dataset is the framework's prepared MNIST (synthetic fallback
+  offline), so the numbers are comparable with `train.py` runs.
+
+Usage: python scripts/DDP_PyTorch_MNIST.py [--ranks 4] [--epochs 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import torch
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from shallowspeed_tpu.data.mnist import ensure_mnist  # noqa: E402
+
+torch.set_num_threads(1)  # reference `DDP_PyTorch_MNIST.py:18`
+
+
+class MLP(torch.nn.Module):
+    """Reference topology 784→64→64→10 (`DDP_PyTorch_MNIST.py:23-33`)."""
+
+    def __init__(self):
+        super().__init__()
+        torch.manual_seed(0)
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(784, 64), torch.nn.ReLU(),
+            torch.nn.Linear(64, 64), torch.nn.ReLU(),
+            torch.nn.Linear(64, 10),
+        )
+
+    def forward(self, x):
+        return self.net(x)
+
+
+def load_data(data_dir):
+    x_tr = np.load(data_dir / "x_train.npy")
+    y_tr = np.load(data_dir / "y_train.npy").argmax(1)
+    x_va = np.load(data_dir / "x_val.npy")
+    y_va = np.load(data_dir / "y_val.npy").argmax(1)
+    return (torch.from_numpy(x_tr), torch.from_numpy(y_tr),
+            torch.from_numpy(x_va), torch.from_numpy(y_va))
+
+
+def accuracy(model, x, y):
+    with torch.no_grad():
+        return (model(x).argmax(1) == y).float().mean().item()
+
+
+def train_serial(x, y, epochs, gbs, lr):
+    model = MLP()
+    opt = torch.optim.Adam(model.parameters(), lr=lr)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    n = len(x) - len(x) % gbs
+    for _ in range(epochs):
+        for b in range(n // gbs):
+            xb, yb = x[b * gbs:(b + 1) * gbs], y[b * gbs:(b + 1) * gbs]
+            opt.zero_grad()
+            loss_fn(model(xb), yb).backward()
+            opt.step()
+    return model
+
+
+def train_ddp(x, y, epochs, gbs, lr, ranks):
+    """R replicas, strided shards, grad all-reduce-mean each step."""
+    replicas = [MLP() for _ in range(ranks)]
+    # identical init (manual_seed in __init__) — assert anyway
+    for r in replicas[1:]:
+        for p0, pr in zip(replicas[0].parameters(), r.parameters()):
+            assert torch.equal(p0, pr)
+    opts = [torch.optim.Adam(m.parameters(), lr=lr) for m in replicas]
+    loss_fn = torch.nn.CrossEntropyLoss()
+    n = len(x) - len(x) % gbs
+    local = gbs // ranks
+    for _ in range(epochs):
+        for b in range(n // gbs):
+            xb, yb = x[b * gbs:(b + 1) * gbs], y[b * gbs:(b + 1) * gbs]
+            for r, (m, o) in enumerate(zip(replicas, opts)):
+                o.zero_grad()
+                # strided shard, like the framework's Dataset (`dataset.py:54-58`)
+                xs, ys = xb[r::ranks], yb[r::ranks]
+                assert len(xs) == local
+                # loss rescale by 1/ranks + SUM allreduce == mean of the
+                # global batch (`DDP_PyTorch_MNIST.py:113`)
+                (loss_fn(m(xs), ys) / ranks).backward()
+            # blocking all-reduce (sum) across replicas (`:119-122`)
+            for params in zip(*(m.parameters() for m in replicas)):
+                total = sum(p.grad for p in params)
+                for p in params:
+                    p.grad = total.clone()
+            for o in opts:
+                o.step()
+        for r in replicas[1:]:
+            for p0, pr in zip(replicas[0].parameters(), r.parameters()):
+                assert torch.equal(p0, pr), "DDP replicas diverged"
+    return replicas[0]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--data-dir", default="data/mnist_784")
+    args = ap.parse_args()
+
+    data_dir = ensure_mnist(Path(args.data_dir))
+    x_tr, y_tr, x_va, y_va = load_data(data_dir)
+
+    t0 = time.time()
+    serial = train_serial(x_tr, y_tr, args.epochs, args.batch_size, args.lr)
+    t_serial = time.time() - t0
+    print(f"serial: {t_serial:.2f}s, "
+          f"test accuracy {accuracy(serial, x_va, y_va) * 100:.2f}%")
+
+    t0 = time.time()
+    ddp = train_ddp(x_tr, y_tr, args.epochs, args.batch_size, args.lr,
+                    args.ranks)
+    t_ddp = time.time() - t0
+    print(f"ddp x{args.ranks}: {t_ddp:.2f}s, "
+          f"test accuracy {accuracy(ddp, x_va, y_va) * 100:.2f}%")
+
+    # abs weight divergence vs the serially trained model (`:159-167`)
+    div = max((a - b).abs().max().item()
+              for a, b in zip(serial.parameters(), ddp.parameters()))
+    print(f"max abs weight divergence vs serial: {div:.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Checkpoint / resume — save and restore training state.
+
+The reference has no checkpointing (SURVEY §5: weights live only in memory,
+`/root/reference/shallowspeed/layers.py:17-28`; the only serialization in its
+repo is the PyTorch baseline's `torch.save`,
+`scripts/DDP_PyTorch_MNIST.py:157-161`). This subsystem goes beyond parity:
+
+- **Canonical format**: model parameters are stored engine-agnostically as
+  the flat list of layer dicts `[{"W", "b"}, ...]` over the *whole* model
+  (the pp=1 view). Every engine can export/import it, so a checkpoint
+  written by a dp=4 fused run restores into a dp=2 x pp=4 SPMD run — the
+  payoff of the reference's deterministic partitioning design
+  (`layers.py:104-113`) carried over to serialized state.
+- **Optimizer state** is engine-shaped (stacked/padded for the SPMD engine),
+  so it round-trips exactly when the engine kind matches and is re-initialized
+  otherwise (with a warning) — resuming SGD is always exact since its state
+  is empty.
+- On-disk format: a single `.npz` (flattened leaves + a pickled treedef),
+  self-contained — no orbax dependency, loadable with plain numpy.
+"""
+
+from __future__ import annotations
+
+import pickle
+import warnings
+from pathlib import Path
+
+import jax
+import numpy as np
+
+tree_flatten = jax.tree_util.tree_flatten
+tree_unflatten = jax.tree_util.tree_unflatten
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(
+        lambda l: np.asarray(jax.device_get(l)), tree)
+
+
+def save_pytree(path, tree) -> None:
+    """One npz per pytree: leaves as arrays, structure pickled alongside."""
+    leaves, treedef = tree_flatten(_to_host(tree))
+    payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
+    payload["treedef"] = np.frombuffer(pickle.dumps(treedef), np.uint8)
+    np.savez_compressed(path, **payload)
+
+
+def load_pytree(path):
+    with np.load(path, allow_pickle=False) as z:
+        treedef = pickle.loads(z["treedef"].tobytes())
+        n = sum(1 for k in z.files if k.startswith("leaf_"))
+        leaves = [z[f"leaf_{i}"] for i in range(n)]
+    return tree_unflatten(treedef, leaves)
+
+
+def save(ckpt_dir, engine, epoch: int) -> Path:
+    """Write `ckpt_dir/ckpt_{epoch}/`: canonical params + engine opt state."""
+    d = Path(ckpt_dir) / f"ckpt_{epoch}"
+    d.mkdir(parents=True, exist_ok=True)
+    save_pytree(d / "params.npz", engine.get_canonical_params())
+    state = {"epoch": epoch, "engine": type(engine).__name__,
+             "opt_state": _to_host(engine.opt_state)}
+    save_pytree(d / "opt.npz", state)
+    return d
+
+
+def latest(ckpt_dir) -> Path | None:
+    d = Path(ckpt_dir)
+    if not d.exists():
+        return None
+    ckpts = sorted(d.glob("ckpt_*"), key=lambda p: int(p.name.split("_")[1]))
+    return ckpts[-1] if ckpts else None
+
+
+def _same_structure(a, b) -> bool:
+    la, ta = tree_flatten(a)
+    lb, tb = tree_flatten(b)
+    return ta == tb and all(
+        np.shape(x) == np.shape(y) for x, y in zip(la, lb))
+
+
+def restore(engine, ckpt_path) -> int:
+    """Load a checkpoint into `engine` (any kind). Returns the next epoch.
+
+    Params restore via the canonical format; optimizer state restores only
+    when its pytree matches the engine's (same kind AND same topology —
+    opt state is engine-shaped, e.g. stacked per-stage for the SPMD engine).
+    """
+    d = Path(ckpt_path)
+    engine.set_canonical_params(load_pytree(d / "params.npz"))
+    state = load_pytree(d / "opt.npz")
+    if (state["engine"] == type(engine).__name__
+            and _same_structure(state["opt_state"], engine.opt_state)):
+        engine.set_opt_state(state["opt_state"])
+    elif len(jax.tree_util.tree_leaves(state["opt_state"])) > 0:
+        warnings.warn(
+            f"checkpoint opt state is {state['engine']}-shaped and does not "
+            f"match this {type(engine).__name__}'s topology; re-initializing")
+    return int(state["epoch"]) + 1

@@ -146,3 +146,18 @@ class FusedDPEngine:
         xs, ys = staged
         self.params, self.opt_state = self._epoch(
             self.params, self.opt_state, xs, ys)
+
+    # -------------------------------------------------- checkpoint interface
+
+    def get_canonical_params(self):
+        """pp=1 params ARE the canonical flat layer list; host conversion
+        happens once in checkpoint.save_pytree."""
+        return self.params
+
+    def set_canonical_params(self, layers):
+        self.params = jax.device_put(
+            [{k: np.asarray(v) for k, v in layer.items()} for layer in layers],
+            self.rep)
+
+    def set_opt_state(self, state):
+        self.opt_state = jax.device_put(state, self.rep)

@@ -1,0 +1,39 @@
+"""Metrics / observability — structured training logs.
+
+The reference's observability is bare `print` (SURVEY §5: epoch/time/accuracy
+lines, `/root/reference/train.py:135-137,150-152`). This keeps that console
+surface (via `utils.rprint`) and adds a structured JSONL sink so runs are
+machine-comparable: one line per epoch with wall-clock, accuracy, and
+throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics writer; no-op when path is falsy."""
+
+    def __init__(self, path=None, **run_info):
+        self.path = Path(path) if path else None
+        self._t0 = time.time()
+        if self.path:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self.log(event="run_start", **run_info)
+
+    def log(self, **fields) -> None:
+        if not self.path:
+            return
+        fields.setdefault("t", round(time.time() - self._t0, 3))
+        with self.path.open("a") as f:
+            f.write(json.dumps(fields) + "\n")
+
+    def epoch(self, epoch: int, accuracy: float, samples: int,
+              epoch_seconds: float) -> None:
+        sps = samples / epoch_seconds if epoch_seconds > 0 else 0.0
+        self.log(event="epoch", epoch=epoch, accuracy=round(accuracy, 6),
+                 epoch_seconds=round(epoch_seconds, 4),
+                 samples_per_sec=round(sps, 1))

@@ -121,8 +121,8 @@ class StageStack:
             layers = []
             for i in range(len(local) - 1):
                 layers.append({
-                    "W": jnp.asarray(W[s, i, : local[i + 1], : local[i]]),
-                    "b": jnp.asarray(b[s, i, :, : local[i + 1]]),
+                    "W": W[s, i, : local[i + 1], : local[i]].copy(),
+                    "b": b[s, i, :, : local[i + 1]].copy(),
                 })
             out.append(layers)
         return out
@@ -407,3 +407,29 @@ class SPMDPipelineEngine:
     @property
     def unstacked_params(self):
         return self.stack.unstack_params(jax.device_get(self.params))
+
+    # -------------------------------------------------- checkpoint interface
+
+    def get_canonical_params(self):
+        return [layer for stage_p in self.unstacked_params
+                for layer in stage_p]
+
+    def set_canonical_params(self, layers):
+        """Re-pad the canonical flat layer list into the stage stack."""
+        st = self.stack
+        W = np.zeros((st.pp, st.L, st.wmax, st.wmax), np.float32)
+        b = np.zeros((st.pp, st.L, 1, st.wmax), np.float32)
+        i = 0
+        for s in range(st.pp):
+            for l in range(st.n_linears[s]):
+                layer = layers[i]
+                W[s, l] = _pad_to(np.asarray(layer["W"]), (st.wmax, st.wmax))
+                b[s, l] = _pad_to(np.asarray(layer["b"]), (1, st.wmax))
+                i += 1
+        assert i == len(layers), (i, len(layers))
+        self.params = jax.device_put({"W": W, "b": b}, self.p_shard)
+
+    def set_opt_state(self, state):
+        self.opt_state = jax.device_put(
+            state,
+            tree_map(lambda s: NamedSharding(self.mesh, s), self._opt_specs))

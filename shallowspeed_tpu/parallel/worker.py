@@ -315,6 +315,30 @@ class PipelineExecutor:
     def params(self):
         return [rt.params for rt in self.runtimes]
 
+    @property
+    def opt_state(self):
+        return [rt.opt_state for rt in self.runtimes]
+
+    # -------------------------------------------------- checkpoint interface
+
+    def get_canonical_params(self):
+        """Concatenate per-stage layer lists into the whole-model flat list."""
+        return [layer for rt in self.runtimes for layer in rt.params]
+
+    def set_canonical_params(self, layers):
+        i = 0
+        for rt in self.runtimes:
+            n = rt.stage.n_linears
+            rt.params = jax.device_put(list(layers[i:i + n]), rt.rep)
+            i += n
+        assert i == len(layers), (i, len(layers))
+
+    def set_opt_state(self, states):
+        assert len(states) == len(self.runtimes), (
+            f"{len(states)} per-stage states for {len(self.runtimes)} stages")
+        for rt, st in zip(self.runtimes, states):
+            rt.opt_state = jax.device_put(st, rt.rep)
+
 
 def _flatten(steps_gen):
     for step in steps_gen:

@@ -1,0 +1,162 @@
+"""Checkpoint/resume tests: exact round-trips, cross-engine interchange
+(payoff of the canonical flat-layer format), and resume-equals-straight-run.
+"""
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import checkpoint
+from shallowspeed_tpu.data.dataset import Dataset
+from shallowspeed_tpu.data.mnist import prepare_mnist
+from shallowspeed_tpu.engine import FusedDPEngine
+from shallowspeed_tpu.models.mlp import MLPStage
+from shallowspeed_tpu.optim import SGD, Adam
+from shallowspeed_tpu.parallel.mesh import make_mesh
+from shallowspeed_tpu.parallel.schedules import GPipeSchedule
+from shallowspeed_tpu.parallel.spmd_pipeline import SPMDPipelineEngine
+from shallowspeed_tpu.parallel.worker import PipelineExecutor
+
+SIZES = [784, 32, 31, 30, 29, 28, 27, 10]
+GBS = 64
+N_MU = 4
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mnist_ckpt")
+    prepare_mnist(d, synthetic=True, n_samples=512)
+    return d
+
+
+def make_ds(data_dir, dp=1):
+    local = GBS // dp
+    return [Dataset(data_dir, GBS, local // N_MU).load(r, dp)
+            for r in range(dp)]
+
+
+def fused_engine(opt=None, dp=1):
+    stage = MLPStage(SIZES, 0, 1, batch_size=GBS)
+    return FusedDPEngine(stage, opt or SGD(0.5), make_mesh(dp, 1))
+
+
+def canon_equal(a, b, rtol=0, atol=0):
+    la, lb = a.get_canonical_params(), b.get_canonical_params()
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        for k in ("W", "b"):
+            if rtol or atol:
+                np.testing.assert_allclose(x[k], y[k], rtol=rtol, atol=atol)
+            else:
+                np.testing.assert_array_equal(x[k], y[k])
+
+
+def test_pytree_roundtrip(tmp_path):
+    tree = {"a": [np.arange(6).reshape(2, 3), np.float32(1.5)],
+            "b": {"c": np.ones((4,), np.int32)}}
+    checkpoint.save_pytree(tmp_path / "t.npz", tree)
+    got = checkpoint.load_pytree(tmp_path / "t.npz")
+    np.testing.assert_array_equal(got["a"][0], tree["a"][0])
+    np.testing.assert_array_equal(got["b"]["c"], tree["b"]["c"])
+
+
+def test_fused_roundtrip_exact(tmp_path, data_dir):
+    eng = fused_engine(opt=Adam(0.01))
+    ds = make_ds(data_dir)
+    for b in range(2):
+        eng.train_batch(b, ds)
+    checkpoint.save(tmp_path, eng, epoch=0)
+
+    eng2 = fused_engine(opt=Adam(0.01))
+    next_epoch = checkpoint.restore(eng2, checkpoint.latest(tmp_path))
+    assert next_epoch == 1
+    canon_equal(eng, eng2)
+    # Adam state round-trips bit-exactly -> continued training is identical
+    for b in range(2, 4):
+        eng.train_batch(b, ds)
+        eng2.train_batch(b, ds)
+    canon_equal(eng, eng2)
+
+
+def test_resume_equals_straight_run(tmp_path, data_dir):
+    ds = make_ds(data_dir)
+    straight = fused_engine()
+    for b in range(4):
+        straight.train_batch(b, ds)
+
+    first = fused_engine()
+    for b in range(2):
+        first.train_batch(b, ds)
+    checkpoint.save(tmp_path, first, epoch=0)
+
+    second = fused_engine()
+    checkpoint.restore(second, checkpoint.latest(tmp_path))
+    for b in range(2, 4):
+        second.train_batch(b, ds)
+    canon_equal(straight, second)
+
+
+def test_cross_engine_fused_to_spmd(tmp_path, data_dir):
+    eng = fused_engine()
+    ds = make_ds(data_dir)
+    for b in range(2):
+        eng.train_batch(b, ds)
+    checkpoint.save(tmp_path, eng, epoch=3)
+
+    spmd = SPMDPipelineEngine(SIZES, SGD(0.5), make_mesh(2, 4), N_MU,
+                              (GBS // 2) // N_MU, GBS)
+    assert checkpoint.restore(spmd, checkpoint.latest(tmp_path)) == 4
+    canon_equal(eng, spmd)
+    x = ds[0].load_micro_batch_input(0, 0)
+    np.testing.assert_allclose(np.asarray(spmd.infer(x)),
+                               np.asarray(eng.infer(x)),
+                               rtol=3e-4, atol=1e-6)
+
+
+def test_cross_engine_spmd_to_vm(tmp_path, data_dir):
+    spmd = SPMDPipelineEngine(SIZES, SGD(0.5), make_mesh(1, 4), N_MU,
+                              GBS // N_MU, GBS)
+    ds = make_ds(data_dir)
+    for b in range(2):
+        spmd.train_batch(b, ds)
+    checkpoint.save(tmp_path, spmd, epoch=0)
+
+    stages = [MLPStage(SIZES, s, 4, batch_size=GBS) for s in range(4)]
+    vm = PipelineExecutor(make_mesh(1, 4), stages, SGD(0.5))
+    checkpoint.restore(vm, checkpoint.latest(tmp_path))
+    canon_equal(spmd, vm)
+
+
+def test_cross_engine_opt_state_warns(tmp_path, data_dir):
+    eng = fused_engine(opt=Adam(0.01))
+    ds = make_ds(data_dir)
+    eng.train_batch(0, ds)
+    checkpoint.save(tmp_path, eng, epoch=0)
+    spmd = SPMDPipelineEngine(SIZES, Adam(0.01), make_mesh(1, 2), N_MU,
+                              GBS // N_MU, GBS)
+    with pytest.warns(UserWarning, match="re-initializing"):
+        checkpoint.restore(spmd, checkpoint.latest(tmp_path))
+
+
+def test_same_class_different_topology_reinits_opt_state(tmp_path, data_dir):
+    """Same engine class but different pp: opt state is engine-shaped per
+    topology, so it must be re-initialized (with a warning), not installed."""
+    stages4 = [MLPStage(SIZES, s, 4, batch_size=GBS) for s in range(4)]
+    vm4 = PipelineExecutor(make_mesh(1, 4), stages4, Adam(0.01))
+    ds = make_ds(data_dir)
+    vm4.train_batch(GPipeSchedule, N_MU, 0, ds)
+    checkpoint.save(tmp_path, vm4, epoch=0)
+
+    stages2 = [MLPStage(SIZES, s, 2, batch_size=GBS) for s in range(2)]
+    vm2 = PipelineExecutor(make_mesh(1, 2), stages2, Adam(0.01))
+    with pytest.warns(UserWarning, match="re-initializing"):
+        checkpoint.restore(vm2, checkpoint.latest(tmp_path))
+    canon_equal(vm4, vm2)
+    vm2.train_batch(GPipeSchedule, N_MU, 1, ds)  # must not crash
+
+
+def test_latest_picks_highest_epoch(tmp_path, data_dir):
+    eng = fused_engine()
+    for e in (0, 2, 10):
+        checkpoint.save(tmp_path, eng, epoch=e)
+    assert checkpoint.latest(tmp_path).name == "ckpt_10"
+    assert checkpoint.latest(tmp_path / "nope") is None

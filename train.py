@@ -50,6 +50,14 @@ def parse_args(argv=None):
     p.add_argument("--data-dir", type=str, default="data/mnist_784")
     p.add_argument("--max-batches", type=int, default=0,
                    help="limit batches per epoch (0 = all); for smoke tests")
+    p.add_argument("--save-dir", type=str, default="",
+                   help="checkpoint directory; saves after every epoch")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --save-dir")
+    p.add_argument("--profile-dir", type=str, default="",
+                   help="write a jax.profiler trace of the training epochs")
+    p.add_argument("--log-file", type=str, default="",
+                   help="append per-epoch JSONL metrics here")
     p.add_argument("--platform", type=str, default=None,
                    choices=["cpu", "tpu"],
                    help="force a JAX platform (this environment pins "
@@ -161,6 +169,12 @@ def compute_accuracy(engine, val_ds) -> float:
 
 
 def train(args) -> float:
+    import contextlib
+
+    import jax
+
+    from shallowspeed_tpu import checkpoint
+    from shallowspeed_tpu.metrics import MetricsLogger
     from shallowspeed_tpu.parallel.schedules import (
         GPipeSchedule, NaiveParallelSchedule, PipeDreamSchedule)
     from shallowspeed_tpu.utils import assert_replicas_in_sync, get_model_hash, rprint
@@ -176,23 +190,46 @@ def train(args) -> float:
     if args.max_batches:
         n_batches = min(n_batches, args.max_batches)
 
+    start_epoch = 0
+    if args.resume:
+        if not args.save_dir:
+            raise SystemExit("--resume requires --save-dir")
+        ck = checkpoint.latest(args.save_dir)
+        if ck is None:
+            raise SystemExit(
+                f"--resume: no checkpoint found under {args.save_dir!r}")
+        start_epoch = checkpoint.restore(engine, ck)
+        rprint(f"resumed from {ck} at epoch {start_epoch}")
+
+    metrics = MetricsLogger(
+        args.log_file, dp=args.dp, pp=args.pp, schedule=args.schedule,
+        engine=type(engine).__name__, batch_size=args.batch_size)
+
     # Fused engines: stage the epoch's batches on device once (HBM-resident)
     # and run each epoch as a single dispatch.
     staged = (engine.stage_epoch(train_ds, n_batches)
               if hasattr(engine, "train_epoch") else None)
 
+    profile_ctx = (jax.profiler.trace(args.profile_dir)
+                   if args.profile_dir else contextlib.nullcontext())
     start = time.time()
     accuracy = 0.0
-    for epoch in range(args.epochs):
-        accuracy = compute_accuracy(engine, val_ds)
-        rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
-               f"Accuracy: {accuracy * 100:.2f}%")
-        if staged is not None:
-            engine.train_epoch(staged)
-        else:
-            for batch_id in range(n_batches):
-                engine.train_batch(schedule_cls, args.mubatches, batch_id,
-                                   train_ds)
+    with profile_ctx:
+        for epoch in range(start_epoch, args.epochs):
+            accuracy = compute_accuracy(engine, val_ds)
+            rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
+                   f"Accuracy: {accuracy * 100:.2f}%")
+            t_epoch = time.time()
+            if staged is not None:
+                engine.train_epoch(staged)
+            else:
+                for batch_id in range(n_batches):
+                    engine.train_batch(schedule_cls, args.mubatches, batch_id,
+                                       train_ds)
+            metrics.epoch(epoch, accuracy, n_batches * args.batch_size,
+                          time.time() - t_epoch)
+            if args.save_dir:
+                checkpoint.save(args.save_dir, engine, epoch)
 
     accuracy = compute_accuracy(engine, val_ds)
     rprint(f"Epoch: {args.epochs}, Time Spent: {time.time() - start:.2f}s, "
