@@ -15,13 +15,23 @@ repo is the PyTorch baseline's `torch.save`,
   so it round-trips exactly when the engine kind matches and is re-initialized
   otherwise (with a warning) — resuming SGD is always exact since its state
   is empty.
-- On-disk format: a single `.npz` (flattened leaves + a pickled treedef),
-  self-contained — no orbax dependency, loadable with plain numpy.
+- On-disk format: one `.npz` per pytree — numbered array leaves plus a JSON
+  structure descriptor. No pickle anywhere (a checkpoint from an untrusted
+  source cannot execute code at load time), no orbax dependency, loadable
+  with plain numpy.
+- **Atomic**: `save` writes `ckpt_N.tmp/` and renames it into place, so a
+  crash mid-save never leaves a directory that `latest()` would pick up;
+  `latest()` additionally ignores incomplete/foreign entries.
+- `restore` validates the checkpoint's parameter structure and shapes
+  against the engine before installing anything — a config-mismatched
+  `--resume` is a hard error, not silent corruption.
 """
 
 from __future__ import annotations
 
-import pickle
+import json
+import re
+import shutil
 import warnings
 from pathlib import Path
 
@@ -29,71 +39,134 @@ import jax
 import numpy as np
 
 tree_flatten = jax.tree_util.tree_flatten
-tree_unflatten = jax.tree_util.tree_unflatten
+
+_FILES = ("params.npz", "opt.npz")
 
 
-def _to_host(tree):
-    return jax.tree_util.tree_map(
-        lambda l: np.asarray(jax.device_get(l)), tree)
+# ----------------------------------------------------------- pytree <-> npz
 
 
-def save_pytree(path, tree) -> None:
-    """One npz per pytree: leaves as arrays, structure pickled alongside."""
-    leaves, treedef = tree_flatten(_to_host(tree))
+def _encode(tree, leaves: list):
+    """Deterministic traversal of dict/list/tuple/None nests; appends array
+    leaves to `leaves` and returns a JSON-able structure spec."""
+    if isinstance(tree, dict):
+        keys = sorted(tree)
+        return {"kind": "dict", "keys": keys,
+                "children": [_encode(tree[k], leaves) for k in keys]}
+    if isinstance(tree, (list, tuple)):
+        kind = "list" if isinstance(tree, list) else "tuple"
+        return {"kind": kind, "children": [_encode(c, leaves) for c in tree]}
+    if tree is None:
+        return {"kind": "none"}
+    leaves.append(np.asarray(jax.device_get(tree)))
+    return {"kind": "leaf", "index": len(leaves) - 1}
+
+
+def _decode(spec, leaves):
+    kind = spec["kind"]
+    if kind == "dict":
+        return {k: _decode(c, leaves)
+                for k, c in zip(spec["keys"], spec["children"])}
+    if kind == "list":
+        return [_decode(c, leaves) for c in spec["children"]]
+    if kind == "tuple":
+        return tuple(_decode(c, leaves) for c in spec["children"])
+    if kind == "none":
+        return None
+    return leaves[spec["index"]]
+
+
+def save_pytree(path, tree, meta: dict | None = None) -> None:
+    """One npz per pytree: numbered array leaves + JSON spec (+ JSON meta)."""
+    leaves: list[np.ndarray] = []
+    spec = _encode(tree, leaves)
     payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
-    payload["treedef"] = np.frombuffer(pickle.dumps(treedef), np.uint8)
+    payload["spec"] = np.frombuffer(
+        json.dumps({"tree": spec, "meta": meta or {}}).encode(), np.uint8)
     np.savez_compressed(path, **payload)
 
 
-def load_pytree(path):
+def load_pytree(path, with_meta: bool = False):
     with np.load(path, allow_pickle=False) as z:
-        treedef = pickle.loads(z["treedef"].tobytes())
+        head = json.loads(z["spec"].tobytes().decode())
         n = sum(1 for k in z.files if k.startswith("leaf_"))
         leaves = [z[f"leaf_{i}"] for i in range(n)]
-    return tree_unflatten(treedef, leaves)
+    tree = _decode(head["tree"], leaves)
+    return (tree, head["meta"]) if with_meta else tree
+
+
+# ------------------------------------------------------------- save/restore
 
 
 def save(ckpt_dir, engine, epoch: int) -> Path:
-    """Write `ckpt_dir/ckpt_{epoch}/`: canonical params + engine opt state."""
-    d = Path(ckpt_dir) / f"ckpt_{epoch}"
-    d.mkdir(parents=True, exist_ok=True)
-    save_pytree(d / "params.npz", engine.get_canonical_params())
-    state = {"epoch": epoch, "engine": type(engine).__name__,
-             "opt_state": _to_host(engine.opt_state)}
-    save_pytree(d / "opt.npz", state)
-    return d
+    """Atomically write `ckpt_dir/ckpt_{epoch}/`: canonical params + engine
+    opt state. Writes into `ckpt_{epoch}.tmp/` and renames into place so a
+    crash mid-save cannot produce a directory `latest()` would select."""
+    final = Path(ckpt_dir) / f"ckpt_{epoch}"
+    tmp = Path(ckpt_dir) / f"ckpt_{epoch}.tmp"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    save_pytree(tmp / "params.npz", engine.get_canonical_params())
+    save_pytree(tmp / "opt.npz", engine.opt_state,
+                meta={"epoch": int(epoch), "engine": type(engine).__name__})
+    if final.exists():
+        shutil.rmtree(final)
+    tmp.rename(final)
+    return final
 
 
 def latest(ckpt_dir) -> Path | None:
+    """Highest-epoch COMPLETE checkpoint directory (ignores `.tmp` leftovers,
+    foreign `ckpt_*` names, and dirs missing either npz)."""
     d = Path(ckpt_dir)
     if not d.exists():
         return None
-    ckpts = sorted(d.glob("ckpt_*"), key=lambda p: int(p.name.split("_")[1]))
-    return ckpts[-1] if ckpts else None
+    best, best_epoch = None, -1
+    for p in d.iterdir():
+        m = re.fullmatch(r"ckpt_(\d+)", p.name)
+        if not m or not all((p / f).exists() for f in _FILES):
+            continue
+        if int(m.group(1)) > best_epoch:
+            best, best_epoch = p, int(m.group(1))
+    return best
 
 
-def _same_structure(a, b) -> bool:
+def _structure_mismatch(a, b) -> str | None:
+    """None if same pytree structure + leaf shapes, else a description."""
     la, ta = tree_flatten(a)
     lb, tb = tree_flatten(b)
-    return ta == tb and all(
-        np.shape(x) == np.shape(y) for x, y in zip(la, lb))
+    if ta != tb:
+        return f"pytree structure {ta} != {tb}"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        if np.shape(x) != np.shape(y):
+            return f"leaf {i} shape {np.shape(x)} != {np.shape(y)}"
+    return None
 
 
 def restore(engine, ckpt_path) -> int:
     """Load a checkpoint into `engine` (any kind). Returns the next epoch.
 
-    Params restore via the canonical format; optimizer state restores only
-    when its pytree matches the engine's (same kind AND same topology —
-    opt state is engine-shaped, e.g. stacked per-stage for the SPMD engine).
+    Params are validated (structure + shapes) against the engine's model
+    config before installation; a mismatch raises instead of silently
+    installing corrupted weights. Optimizer state restores only when its
+    pytree matches the engine's (same kind AND same topology — opt state is
+    engine-shaped, e.g. stacked per-stage for the SPMD engine).
     """
     d = Path(ckpt_path)
-    engine.set_canonical_params(load_pytree(d / "params.npz"))
-    state = load_pytree(d / "opt.npz")
-    if (state["engine"] == type(engine).__name__
-            and _same_structure(state["opt_state"], engine.opt_state)):
-        engine.set_opt_state(state["opt_state"])
-    elif len(jax.tree_util.tree_leaves(state["opt_state"])) > 0:
+    params = load_pytree(d / "params.npz")
+    mismatch = _structure_mismatch(params, engine.get_canonical_params())
+    if mismatch is not None:
+        raise ValueError(
+            f"checkpoint {d} does not match this engine's model config "
+            f"({mismatch}); refusing to restore")
+    engine.set_canonical_params(params)
+    opt_state, meta = load_pytree(d / "opt.npz", with_meta=True)
+    if (meta["engine"] == type(engine).__name__
+            and _structure_mismatch(opt_state, engine.opt_state) is None):
+        engine.set_opt_state(opt_state)
+    elif len(jax.tree_util.tree_leaves(opt_state)) > 0:
         warnings.warn(
-            f"checkpoint opt state is {state['engine']}-shaped and does not "
+            f"checkpoint opt state is {meta['engine']}-shaped and does not "
             f"match this {type(engine).__name__}'s topology; re-initializing")
-    return int(state["epoch"]) + 1
+    return int(meta["epoch"]) + 1

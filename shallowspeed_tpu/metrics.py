@@ -31,9 +31,19 @@ class MetricsLogger:
         with self.path.open("a") as f:
             f.write(json.dumps(fields) + "\n")
 
-    def epoch(self, epoch: int, accuracy: float, samples: int,
+    def epoch(self, epoch: int, accuracy_start: float, samples: int,
               epoch_seconds: float) -> None:
+        """One record per training epoch. `accuracy_start` is the validation
+        accuracy measured BEFORE this epoch's updates (the reference's print
+        semantics, `train.py:135-137`); the trained result lands in the
+        `final` record."""
         sps = samples / epoch_seconds if epoch_seconds > 0 else 0.0
-        self.log(event="epoch", epoch=epoch, accuracy=round(accuracy, 6),
+        self.log(event="epoch", epoch=epoch,
+                 accuracy_start=round(accuracy_start, 6),
                  epoch_seconds=round(epoch_seconds, 4),
                  samples_per_sec=round(sps, 1))
+
+    def final(self, accuracy: float, total_seconds: float) -> None:
+        """Post-training validation accuracy — the run's headline result."""
+        self.log(event="final", accuracy=round(accuracy, 6),
+                 total_seconds=round(total_seconds, 3))

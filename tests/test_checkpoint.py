@@ -160,3 +160,54 @@ def test_latest_picks_highest_epoch(tmp_path, data_dir):
         checkpoint.save(tmp_path, eng, epoch=e)
     assert checkpoint.latest(tmp_path).name == "ckpt_10"
     assert checkpoint.latest(tmp_path / "nope") is None
+
+
+def test_latest_ignores_partial_and_foreign_entries(tmp_path, data_dir):
+    """A crash mid-save (simulated: missing opt.npz), a leftover .tmp dir,
+    and a stray non-numeric ckpt_* name must not break or win latest()."""
+    eng = fused_engine()
+    checkpoint.save(tmp_path, eng, epoch=1)
+    (tmp_path / "ckpt_99").mkdir()  # partial: no npz files at all
+    partial = tmp_path / "ckpt_50"
+    partial.mkdir()
+    checkpoint.save_pytree(partial / "params.npz", [])  # missing opt.npz
+    (tmp_path / "ckpt_7.tmp").mkdir()
+    (tmp_path / "ckpt_backup").mkdir()
+    assert checkpoint.latest(tmp_path).name == "ckpt_1"
+
+
+def test_restore_rejects_config_mismatch(tmp_path, data_dir):
+    """Restoring a checkpoint from a different model config must raise, not
+    silently install wrong weights (same layer COUNT, different widths)."""
+    eng = fused_engine()
+    checkpoint.save(tmp_path, eng, epoch=0)
+    other_sizes = [784, 64, 63, 62, 61, 60, 59, 10]
+    other = FusedDPEngine(MLPStage(other_sizes, 0, 1, batch_size=GBS),
+                          SGD(0.5), make_mesh(1, 1))
+    with pytest.raises(ValueError, match="model config"):
+        checkpoint.restore(other, checkpoint.latest(tmp_path))
+    spmd = SPMDPipelineEngine(other_sizes, SGD(0.5), make_mesh(1, 4), N_MU,
+                              GBS // N_MU, GBS)
+    with pytest.raises(ValueError, match="model config"):
+        checkpoint.restore(spmd, checkpoint.latest(tmp_path))
+
+
+def test_save_overwrites_same_epoch(tmp_path, data_dir):
+    eng = fused_engine()
+    ds = make_ds(data_dir)
+    checkpoint.save(tmp_path, eng, epoch=0)
+    eng.train_batch(0, ds)
+    checkpoint.save(tmp_path, eng, epoch=0)  # rename over existing dir
+    eng2 = fused_engine()
+    checkpoint.restore(eng2, checkpoint.latest(tmp_path))
+    canon_equal(eng, eng2)
+
+
+def test_no_pickle_in_checkpoint_files(tmp_path, data_dir):
+    """The on-disk format must load with allow_pickle=False (no code
+    execution on untrusted checkpoints)."""
+    eng = fused_engine(opt=Adam(0.01))
+    checkpoint.save(tmp_path, eng, epoch=0)
+    for f in ("params.npz", "opt.npz"):
+        with np.load(tmp_path / "ckpt_0" / f, allow_pickle=False) as z:
+            assert "spec" in z.files

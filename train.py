@@ -226,6 +226,9 @@ def train(args) -> float:
                 for batch_id in range(n_batches):
                     engine.train_batch(schedule_cls, args.mubatches, batch_id,
                                        train_ds)
+            # JAX dispatch is async: wait for the params update to land so
+            # the logged epoch time measures compute, not dispatch.
+            jax.block_until_ready(engine.params)
             metrics.epoch(epoch, accuracy, n_batches * args.batch_size,
                           time.time() - t_epoch)
             if args.save_dir:
@@ -234,6 +237,7 @@ def train(args) -> float:
     accuracy = compute_accuracy(engine, val_ds)
     rprint(f"Epoch: {args.epochs}, Time Spent: {time.time() - start:.2f}s, "
            f"Accuracy: {accuracy * 100:.2f}%")
+    metrics.final(accuracy, time.time() - start)
 
     # Sanity check: DP replicas hold bit-identical weights (reference
     # `train.py:154-155`, `utils.py:27-31`).
