@@ -5,3 +5,6 @@ from shallowspeed_tpu.models.mlp import (  # noqa: F401
     stage_layer_sizes,
     zero_grads_like,
 )
+from shallowspeed_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+)

@@ -1,0 +1,137 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+The reference's model zoo is a single attention-free MLP
+(`/root/reference/shallowspeed/layers.py:236-270`); this family extends the
+framework to sequence models, designed TPU-first from the start:
+
+- Pure-functional: `init(rng) -> params pytree`, `forward(params, tokens) ->
+  logits`, `loss(params, tokens, targets)`; autograd is `jax.grad` (no
+  hand-written VJPs here — the MLP family keeps those for reference parity,
+  this family uses the idiomatic JAX transform).
+- The attention implementation is pluggable: the same block runs full
+  `attention` on one device or `ring_attention` over a sequence-sharded mesh
+  axis (`shallowspeed_tpu/ops/attention.py`) — which is what makes context
+  parallelism a property of the *mesh*, not of the model code.
+- Pre-LN blocks, GELU MLP (4x), learned positional embeddings, weight-tied
+  head kept separate (untied) for sharding simplicity; all matmul-heavy, so
+  every FLOP lands on the MXU. bfloat16-friendly: compute dtype is a config
+  knob, accumulations stay float32 inside attention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shallowspeed_tpu.ops.attention import attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    max_seq: int = 1024
+    dtype: np.dtype = np.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _dense_init(rng, in_d, out_d, dtype):
+    w = rng.normal(0.0, 1.0 / np.sqrt(in_d), (in_d, out_d)).astype(dtype)
+    return {"W": w, "b": np.zeros((out_d,), dtype)}
+
+
+def init(cfg: TransformerConfig, seed: int = 0):
+    """Host-side deterministic init (seeded like the MLP family's
+    dims-keyed init, `layers.py:104-113`, but one seed for the whole tree)."""
+    rng = np.random.default_rng(seed)
+    dt = cfg.dtype
+    d = cfg.d_model
+    blocks = []
+    for _ in range(cfg.n_layers):
+        blocks.append({
+            "ln1": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
+            "qkv": _dense_init(rng, d, 3 * d, dt),
+            "proj": _dense_init(rng, d, d, dt),
+            "ln2": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
+            "up": _dense_init(rng, d, 4 * d, dt),
+            "down": _dense_init(rng, 4 * d, d, dt),
+        })
+    return {
+        "tok_emb": rng.normal(0.0, 0.02, (cfg.vocab, d)).astype(dt),
+        "pos_emb": rng.normal(0.0, 0.02, (cfg.max_seq, d)).astype(dt),
+        "blocks": blocks,
+        "ln_f": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
+        "head": _dense_init(rng, d, cfg.vocab, dt),
+    }
+
+
+def _layernorm(p, x, eps=1e-5):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["g"] + p["b"]
+
+
+def _dense(p, x):
+    return x @ p["W"] + p["b"]
+
+
+def _block(p, x, cfg: TransformerConfig, attn_fn):
+    b, t, d = x.shape
+    h = _layernorm(p["ln1"], x)
+    qkv = _dense(p["qkv"], h).reshape(b, t, 3, cfg.n_heads, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    a = attn_fn(q, k, v).reshape(b, t, d)
+    x = x + _dense(p["proj"], a)
+    h = _layernorm(p["ln2"], x)
+    return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h)))
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            attn_fn=None, pos_offset=0):
+    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab).
+
+    `attn_fn(q, k, v)` defaults to full causal attention; a context-parallel
+    caller passes `partial(ring_attention, axis_name='sp')` and the global
+    `pos_offset` of its sequence block (positions are global under sequence
+    sharding).
+    """
+    if attn_fn is None:
+        attn_fn = partial(attention, causal=True)
+    b, t = tokens.shape
+    # Under jit an out-of-range gather silently clamps to pos_emb's last row;
+    # guard statically where possible (pos_offset is traced in the
+    # context-parallel path — the engine checks the global length instead).
+    if isinstance(pos_offset, int):
+        assert pos_offset + t <= cfg.max_seq, (
+            f"sequence positions [{pos_offset}, {pos_offset + t}) exceed "
+            f"max_seq={cfg.max_seq}")
+    pos = pos_offset + jnp.arange(t)
+    x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    for blk in params["blocks"]:
+        x = _block(blk, x, cfg, attn_fn)
+    x = _layernorm(params["ln_f"], x)
+    return _dense(params["head"], x)
+
+
+def loss(params, tokens, targets, cfg: TransformerConfig,
+         attn_fn=None, pos_offset=0):
+    """Mean softmax cross-entropy over all (batch, seq) positions.
+
+    Under data/sequence sharding the mean over the LOCAL block is returned;
+    the caller averages across shards (`lax.pmean`) — exact because all
+    blocks have equal size.
+    """
+    logits = forward(params, tokens, cfg, attn_fn, pos_offset)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()

@@ -8,3 +8,7 @@ from shallowspeed_tpu.ops.functional import (  # noqa: F401
     softmax,
     softmax_grad,
 )
+from shallowspeed_tpu.ops.attention import (  # noqa: F401
+    attention,
+    ring_attention,
+)

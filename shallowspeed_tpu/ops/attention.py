@@ -1,0 +1,116 @@
+"""Attention ops — full softmax attention and ring attention.
+
+The reference has no attention anywhere (SURVEY §2: the model zoo is an
+attention-free MLP, `/root/reference/shallowspeed/layers.py:236-270`), so this
+module is a capability *extension*: long-context sequence/context parallelism
+is first-class in this framework, built the TPU way:
+
+- `attention`: plain batched multi-head attention, one fused XLA program —
+  two MXU einsums around a VPU softmax. The single-device reference
+  semantics for the ring variant.
+- `ring_attention`: blockwise attention over a sequence-sharded mesh axis.
+  Each device owns one sequence block of Q/K/V; K/V blocks rotate around the
+  ring with `lax.ppermute` (one ICI neighbor hop per step) while each device
+  accumulates its queries' attention with an online-softmax running
+  (max, sum, out) state — numerically identical (up to fp reorder) to full
+  attention over the gathered sequence, with O(T_local) memory and
+  compute/communication overlap (the ppermute of step i+1's block overlaps
+  the einsums of step i under XLA's latency-hiding scheduler).
+
+Both are differentiable with `jax.grad` (the transformer family uses JAX
+autodiff as its autograd, unlike the MLP family's hand-written VJPs that
+mirror the reference's manual backprop layer).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+_NEG = jnp.float32(-1e30)
+
+
+def _scores(q: Array, k: Array, scale: float) -> Array:
+    """(B, H, Tq, Tk) scaled logits from (B, T, H, D) blocks."""
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+
+
+def attention(q: Array, k: Array, v: Array, causal: bool = True) -> Array:
+    """Multi-head scaled-dot-product attention.
+
+    q, k, v: (batch, seq, heads, head_dim). Returns (batch, seq, heads,
+    head_dim). With `causal`, position i attends to positions <= i.
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = _scores(q, k, scale)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q: Array, k: Array, v: Array, axis_name: str,
+                   causal: bool = True) -> Array:
+    """Blockwise ring attention over the sequence-sharded `axis_name`.
+
+    q, k, v: (batch, seq_local, heads, head_dim) — this device's sequence
+    block; the global sequence is the concatenation of blocks in mesh-axis
+    order. Returns this device's (batch, seq_local, heads, head_dim) output,
+    equal (up to float reassociation) to slicing full `attention` over the
+    gathered sequence.
+
+    Ring step i processes the K/V block originating at device
+    `(idx - i) mod n` while `ppermute` forwards the in-flight block to the
+    right neighbor; the online softmax state (running max m, normalizer l,
+    unnormalized out o) makes the result order-independent.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    q32 = q.astype(jnp.float32)
+
+    qpos = idx * t + jnp.arange(t)  # global positions of this block's queries
+    # K/V travel right one hop per step => step i sees the block of
+    # device (idx - i) mod n.
+
+    def step(carry, i):
+        o, m, l, kb, vb = carry
+        src = (idx - i) % n
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32)) * scale
+        if causal:
+            kpos = src * t + jnp.arange(t)
+            mask = qpos[:, None] >= kpos[None, :]        # (tq, tk)
+            s = jnp.where(mask[None, None], s, _NEG)
+            valid = mask[None, None]
+        else:
+            valid = jnp.ones(s.shape, bool)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        # Explicitly zero masked entries: when an entire block is masked,
+        # exp(_NEG - _NEG) would be 1 and corrupt the normalizer.
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        # o layout is (b, t, h, d); alpha is (b, h, t, 1) -> align axes
+        alpha_o = alpha[..., 0].transpose(0, 2, 1)[..., None]  # (b, t, h, 1)
+        o_new = o * alpha_o + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (o_new, m_new, l_new, kb, vb), None
+
+    # The scan carry must have the same shard_map variance type as the
+    # ppermute outputs; deriving the init from q (a zero-valued scalar that
+    # carries q's variance) handles any enclosing mesh (dp, sp, ...) without
+    # naming axes here.
+    zq = q32.sum() * 0.0
+    o0 = jnp.zeros((b, t, h, d), jnp.float32) + zq
+    m0 = jnp.full((b, h, t, 1), _NEG) + zq
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32) + zq
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    l_o = l[..., 0].transpose(0, 2, 1)[..., None]  # (b, t, h, 1)
+    return (o / jnp.maximum(l_o, 1e-30)).astype(q.dtype)

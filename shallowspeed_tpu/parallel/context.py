@@ -1,0 +1,145 @@
+"""Context/sequence parallelism — long-context training over a (dp, sp) mesh.
+
+The reference cannot scale sequence length at all (SURVEY §5: no sequence
+dimension anywhere). This engine makes long context a first-class axis the
+TPU way: shard the *sequence* over the `sp` mesh axis, run `ring_attention`
+(K/V blocks rotating over ICI via `ppermute`,
+`shallowspeed_tpu/ops/attention.py`) so no device ever materializes the full
+(T, T) score matrix or the full sequence's activations, and compose with
+batch sharding over `dp` in the same `shard_map`:
+
+- tokens/targets: (B, T) sharded (dp, sp) — each device holds a
+  (B/dp, T/sp) tile.
+- params: replicated; every device computes the gradient contribution of its
+  tile and one `pmean` over ('dp', 'sp') recovers the exact global-mean
+  gradient (all tiles are equal-sized, so mean-of-means is exact — the same
+  scaling invariant the MLP family inherits from the reference,
+  `functional.py:43-44`).
+- autograd: `jax.grad` straight through the ring collective (JAX
+  differentiates `ppermute`), so the backward pass runs the ring in reverse
+  automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.ops.attention import ring_attention
+
+
+class ContextParallelEngine:
+    """Data x sequence parallel trainer for the transformer LM family."""
+
+    def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
+                 seed: int = 0):
+        assert mesh.axis_names == ("dp", "sp")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dp, self.sp = mesh.devices.shape
+        self.optimizer = optimizer
+        self.rep = NamedSharding(mesh, P())
+        self.tile = NamedSharding(mesh, P("dp", "sp"))
+
+        self.params = jax.device_put(T.init(cfg, seed), self.rep)
+        self.opt_state = jax.device_put(optimizer.init(self.params), self.rep)
+
+        opt = optimizer
+        attn = partial(ring_attention, axis_name="sp", causal=True)
+
+        def local_loss(params, tokens, targets):
+            t_local = tokens.shape[1]
+            off = jax.lax.axis_index("sp") * t_local
+            return T.loss(params, tokens, targets, cfg,
+                          attn_fn=attn, pos_offset=off)
+
+        n_tiles = self.dp * self.sp
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
+                 out_specs=(P(), P(), P()))
+        def _step(params, opt_state, tokens, targets):
+            # Params are mesh-invariant (replicated), the per-tile loss is
+            # varying: jax.grad's transpose of that broadcast IS a psum over
+            # ('dp','sp') — the gradient arrives already summed across tiles.
+            # Scaling the local loss by 1/n_tiles therefore yields exactly
+            # the global-mean gradient (equal tiles => mean of means), with
+            # the DP all-reduce emitted by autodiff instead of hand-placed
+            # (the XLA-native version of the reference's interleaved
+            # Iallreduce, `pipe.py:302-327`).
+            def scaled(p):
+                return local_loss(p, tokens, targets) / n_tiles
+
+            lloc, grads = jax.value_and_grad(scaled)(params)
+            loss = jax.lax.pmean(lloc * n_tiles, ("dp", "sp"))
+            params, opt_state = opt.step(params, grads, opt_state)
+            return params, opt_state, loss
+
+        @jax.jit
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P("dp", "sp"), P("dp", "sp")),
+                 out_specs=P())
+        def _eval(params, tokens, targets):
+            return jax.lax.pmean(
+                local_loss(params, tokens, targets), ("dp", "sp"))
+
+        @jax.jit
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), P("dp", "sp")), out_specs=P("dp", "sp"))
+        def _logits(params, tokens):
+            t_local = tokens.shape[1]
+            off = jax.lax.axis_index("sp") * t_local
+            return T.forward(params, tokens, cfg, attn_fn=attn,
+                             pos_offset=off)
+
+        self._step_fn = _step
+        self._eval_fn = _eval
+        self._logits_fn = _logits
+
+    # -------------------------------------------------------------- data
+
+    def _place(self, arr: np.ndarray):
+        b, t = arr.shape[:2]
+        assert b % self.dp == 0, (b, self.dp)
+        assert t % self.sp == 0, (t, self.sp)
+        assert t <= self.cfg.max_seq, (
+            f"global sequence length {t} exceeds max_seq={self.cfg.max_seq}")
+        return jax.device_put(arr, self.tile)
+
+    # -------------------------------------------------------------- steps
+
+    def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        """One optimizer step on a (B, T) int token batch; returns the loss."""
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state,
+            self._place(tokens), self._place(targets))
+        return float(loss)
+
+    def eval_loss(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        return float(self._eval_fn(
+            self.params, self._place(tokens), self._place(targets)))
+
+    def logits(self, tokens: np.ndarray) -> jax.Array:
+        return self._logits_fn(self.params, self._place(tokens))
+
+    # -------------------------------------------- checkpoint interface
+
+    def get_canonical_params(self):
+        return self.params
+
+    def set_canonical_params(self, params):
+        self.params = jax.device_put(params, self.rep)
+
+    def set_opt_state(self, state):
+        self.opt_state = jax.device_put(state, self.rep)

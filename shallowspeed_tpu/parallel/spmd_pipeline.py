@@ -48,6 +48,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from shallowspeed_tpu.models.mlp import init_linear_np, stage_layer_sizes
+from shallowspeed_tpu.utils import pvary_over as _pvary
 
 tree_map = jax.tree_util.tree_map
 
@@ -56,20 +57,6 @@ def _pad_to(arr: np.ndarray, shape) -> np.ndarray:
     out = np.zeros(shape, arr.dtype)
     out[tuple(slice(0, s) for s in arr.shape)] = arr
     return out
-
-
-def _pvary(x, axes):
-    """Cast a pytree to 'varying' over the given mesh axes (shard_map VMA).
-    Skips axes a leaf already varies over (pcast rejects those)."""
-    def cast(leaf):
-        for ax in axes:
-            try:
-                leaf = jax.lax.pcast(leaf, (ax,), to="varying")
-            except ValueError:
-                pass  # already varying over this axis
-        return leaf
-
-    return tree_map(cast, x)
 
 
 class StageStack:

@@ -57,3 +57,22 @@ def assert_replicas_in_sync(params: Any) -> None:
                 raise AssertionError(
                     f"DP replicas out of sync for leaf {leaf.shape} slice "
                     f"{key}: {sorted(hashes)}")
+
+
+def pvary_over(tree: Any, axes: tuple[str, ...]) -> Any:
+    """Cast a pytree to 'varying' over the given shard_map mesh axes (VMA).
+
+    Inside `shard_map`, axis-invariant constants (e.g. a zeros scan-carry
+    init) and axis-varying data (e.g. outputs of `ppermute`) have different
+    types; this casts the former so carries typecheck. Skips axes a leaf
+    already varies over (pcast rejects those).
+    """
+    def cast(leaf):
+        for ax in axes:
+            try:
+                leaf = jax.lax.pcast(leaf, (ax,), to="varying")
+            except ValueError:
+                pass  # already varying over this axis
+        return leaf
+
+    return jax.tree_util.tree_map(cast, tree)

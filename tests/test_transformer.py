@@ -1,0 +1,99 @@
+"""Transformer LM + context-parallel engine tests.
+
+Equivalence strategy as everywhere in this framework: the sharded run must
+match the serial run (reference's own check,
+`scripts/DDP_PyTorch_MNIST.py:159-167`) — here dp x sp tiles vs a
+single-device full-attention run, through a full optimizer step.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.optim import SGD, Adam
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                          max_seq=64)
+
+
+def toy_batch(b=4, t=32, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, CFG.vocab, (b, t)).astype(np.int32)
+    # next-token targets of a repeat-previous task: learnable quickly
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    return tokens, targets
+
+
+def make_mesh(dp, sp):
+    devs = np.array(jax.devices()[: dp * sp]).reshape(dp, sp)
+    return Mesh(devs, ("dp", "sp"))
+
+
+def test_forward_shapes_and_loss_finite():
+    params = T.init(CFG, seed=1)
+    tokens, targets = toy_batch()
+    logits = T.forward(params, tokens, CFG)
+    assert logits.shape == (4, 32, CFG.vocab)
+    loss = T.loss(params, tokens, targets, CFG)
+    assert np.isfinite(float(loss))
+    # untrained loss ~ log(vocab)
+    assert abs(float(loss) - np.log(CFG.vocab)) < 0.5
+
+
+@pytest.mark.parametrize("dp,sp", [(1, 1), (2, 1), (1, 4), (2, 4)])
+def test_context_parallel_step_matches_serial(dp, sp):
+    """One full train step on a (dp, sp) mesh equals the single-device step."""
+    tokens, targets = toy_batch()
+
+    serial = ContextParallelEngine(CFG, SGD(0.1), make_mesh(1, 1), seed=3)
+    l0 = serial.train_batch(tokens, targets)
+
+    eng = ContextParallelEngine(CFG, SGD(0.1), make_mesh(dp, sp), seed=3)
+    l1 = eng.train_batch(tokens, targets)
+
+    assert abs(l0 - l1) < 1e-5
+    flat_a = jax.tree_util.tree_leaves(serial.params)
+    flat_b = jax.tree_util.tree_leaves(eng.params)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_context_parallel_training_learns():
+    """Loss decreases substantially on the toy next-token task under dp=2, sp=4."""
+    eng = ContextParallelEngine(CFG, Adam(1e-2), make_mesh(2, 4), seed=0)
+    tokens, targets = toy_batch(seed=5)
+    first = eng.eval_loss(tokens, targets)
+    for _ in range(30):
+        eng.train_batch(tokens, targets)
+    last = eng.eval_loss(tokens, targets)
+    assert last < first * 0.5, (first, last)
+
+
+def test_logits_match_full_attention_reference():
+    """Sharded inference logits == direct full-attention forward."""
+    eng = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 4), seed=9)
+    tokens, _ = toy_batch(seed=2)
+    got = np.asarray(eng.logits(tokens))
+    params_host = jax.device_get(eng.params)
+    want = np.asarray(T.forward(params_host, tokens, CFG))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_checkpoint_roundtrip_context_engine(tmp_path):
+    from shallowspeed_tpu import checkpoint
+
+    eng = ContextParallelEngine(CFG, Adam(1e-3), make_mesh(2, 4), seed=4)
+    tokens, targets = toy_batch(seed=1)
+    eng.train_batch(tokens, targets)
+    checkpoint.save(tmp_path, eng, epoch=0)
+
+    eng2 = ContextParallelEngine(CFG, Adam(1e-3), make_mesh(1, 2), seed=99)
+    assert checkpoint.restore(eng2, checkpoint.latest(tmp_path)) == 1
+    # continued training matches bit-for-bit modulo topology reassociation
+    la = eng.train_batch(tokens, targets)
+    lb = eng2.train_batch(tokens, targets)
+    assert abs(la - lb) < 1e-5
