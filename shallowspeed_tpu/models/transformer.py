@@ -88,8 +88,12 @@ def _dense(p, x):
 def _block(p, x, cfg: TransformerConfig, attn_fn):
     b, t, d = x.shape
     h = _layernorm(p["ln1"], x)
-    qkv = _dense(p["qkv"], h).reshape(b, t, 3, cfg.n_heads, cfg.head_dim)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    # head-major fused layout (H, 3, D): a contiguous slice of the 3d output
+    # dim is a whole group of heads, so tensor-parallel column sharding of
+    # qkv["W"] keeps attention fully local to each device (Megatron
+    # alignment; see parallel/tensor.py).
+    qkv = _dense(p["qkv"], h).reshape(b, t, cfg.n_heads, 3, cfg.head_dim)
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
     a = attn_fn(q, k, v).reshape(b, t, d)
     x = x + _dense(p["proj"], a)
     h = _layernorm(p["ln2"], x)

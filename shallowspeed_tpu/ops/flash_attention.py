@@ -1,0 +1,330 @@
+"""Flash attention — fused blockwise attention as Pallas TPU kernels.
+
+The hot op of the transformer family (`models/transformer.py`). XLA compiles
+the naive `ops.attention` into einsum+softmax+einsum with the full (T, T)
+score matrix materialized in HBM; this kernel computes attention blockwise in
+VMEM with an online softmax (the FlashAttention-2 formulation), so HBM
+traffic is O(T·D) instead of O(T²) and the MXU stays fed from on-chip
+memory. Three kernels:
+
+- forward: per (batch·head, q-block) grid cell, fori_loop over k-blocks with
+  running (max m, normalizer l, accumulator acc) state; causal masking skips
+  whole k-blocks past the diagonal (the loop bound itself shrinks). Saves
+  the log-sum-exp for the backward.
+- backward-dq: same q-block grid; recomputes p from (q, k, lse), forms
+  ds = p * (dp - delta) and accumulates dq = Σ ds·k.
+- backward-dkv: k-block grid; loops over the q-blocks at/after the diagonal
+  accumulating dv = Σ pᵀ·do and dk = Σ dsᵀ·q.
+
+Wrapped in `jax.custom_vjp`, so `jax.grad` through the transformer uses the
+fused backward. On non-TPU backends the kernels run in Pallas interpret mode
+(exact same code path, used by the CPU test suite); on TPU they compile via
+Mosaic. Layout contract matches `ops.attention`: (batch, seq, heads,
+head_dim).
+
+Written per /opt/skills/guides/pallas_guide.md (blockwise VMEM tiling,
+online-softmax accumulators, preferred_element_type=f32 on every MXU dot,
+@pl.when for edge blocks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG = -1e30  # plain float: jnp scalars would be captured consts in kernels
+_LANES = 128  # Mosaic min lane width: row stats (lse/delta) pad to this
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_k):
+    iq = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)                       # (bq, D)
+    d = q.shape[-1]
+
+    nkb = seq_k // block_k
+    if causal:
+        # q rows of this block end at global row iq*bq + bq - 1; k blocks
+        # strictly past that are fully masked — shrink the loop bound.
+        last = (iq * block_q + block_q - 1) // block_k
+        nkb_eff = jnp.minimum(nkb, last + 1)
+    else:
+        nkb_eff = nkb
+
+    qrow = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            kcol = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = qrow >= kcol
+            s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), _NEG)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nkb_eff, body, (m0, l0, acc0))
+
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # row stats broadcast across a 128-lane dim (Mosaic min tile width)
+    lse_ref[:] = jnp.broadcast_to(
+        m + jnp.log(jnp.maximum(l, 1e-30)), (block_q, _LANES))
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k, seq_k):
+    iq = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:, 0:1]
+    delta = delta_ref[:, 0:1]
+    d = q.shape[-1]
+
+    nkb = seq_k // block_k
+    if causal:
+        last = (iq * block_q + block_q - 1) // block_k
+        nkb_eff = jnp.minimum(nkb, last + 1)
+    else:
+        nkb_eff = nkb
+
+    qrow = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, dq):
+        kb = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            kcol = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qrow >= kcol, s, _NEG)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, nkb_eff, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_q):
+    jk = pl.program_id(1)
+    kb = k_ref[:].astype(jnp.float32)                      # (bk, D)
+    vb = v_ref[:].astype(jnp.float32)
+    d = kb.shape[-1]
+
+    nqb = seq_q // block_q
+    if causal:
+        # q blocks strictly before this k block are fully masked
+        first = (jk * block_k) // block_q
+    else:
+        first = 0
+
+    kcol = jk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
+        delta = delta_ref[pl.ds(i * block_q, block_q), 0:1]
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qrow = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(qrow >= kcol, s, _NEG)
+        p = jnp.exp(s - lse)
+        dv = dv + jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first, nqb, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------- entry points
+
+
+def _to_bhsd(x):
+    """(B, T, H, D) -> (B*H, T, D) for the (batch·head, block) grid."""
+    b, t, h, d = x.shape
+    return jnp.reshape(jnp.transpose(x, (0, 2, 1, 3)), (b * h, t, d))
+
+
+def _from_bhsd(x, b, h):
+    bh, t, d = x.shape
+    return jnp.transpose(jnp.reshape(x, (b, h, t, d)), (0, 2, 1, 3))
+
+
+def _pick_block(t: int, want: int) -> int:
+    while t % want:
+        want //= 2
+    return max(want, 1)
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct inheriting `like`'s shard_map variance (vma), so the
+    kernels compose with explicit-sharding engines (pallas_call under
+    shard_map requires explicit output vma)."""
+    vma = getattr(getattr(like, "aval", None), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused multi-head attention; same contract as `ops.attention`.
+
+    q, k, v: (batch, seq, heads, head_dim) -> (batch, seq, heads, head_dim).
+    Sequence lengths must be divisible by the (auto-shrunk) block sizes.
+    `interpret=None` auto-selects Pallas interpret mode off-TPU.
+    """
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    scale = 1.0 / float(np.sqrt(d))
+
+    q3, k3, v3 = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    bh = b * h
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, seq_k=tk)
+    o3, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, tq // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            _sds((bh, tq, d), q.dtype, q3),
+            _sds((bh, tq, _LANES), jnp.float32, q3),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return _from_bhsd(o3, b, h), (q, k, v, _from_bhsd(o3, b, h), lse)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    o, res = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o, res
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    if interpret is None:
+        interpret = _interpret_default()
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    scale = 1.0 / float(np.sqrt(d))
+    bh = b * h
+
+    q3, k3, v3 = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    o3, do3 = _to_bhsd(o), _to_bhsd(do)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term,
+    # broadcast across the 128-lane stats dim like lse
+    delta = jnp.broadcast_to(
+        jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        lse.shape)
+
+    dq_kernel = functools.partial(_dq_kernel, scale=scale, causal=causal,
+                                  block_q=bq, block_k=bk, seq_k=tk)
+    dq3 = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, tq // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=_sds((bh, tq, d), q.dtype, q3),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dkv_kernel = functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                                   block_q=bq, block_k=bk, seq_q=tq)
+    dk3, dv3 = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, tk // bk),
+        in_specs=[
+            pl.BlockSpec((None, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, tq, _LANES), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, tq, _LANES), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            _sds((bh, tk, d), k.dtype, q3),
+            _sds((bh, tk, d), v.dtype, q3),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    return (_from_bhsd(dq3, b, h), _from_bhsd(dk3, b, h),
+            _from_bhsd(dv3, b, h))
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -39,10 +39,18 @@ from shallowspeed_tpu.ops.attention import ring_attention
 
 
 class ContextParallelEngine:
-    """Data x sequence parallel trainer for the transformer LM family."""
+    """Data x sequence parallel trainer for the transformer LM family.
+
+    `attn` selects the attention substrate:
+    - "ring" (default): `ring_attention` over the 'sp' axis — required for
+      sp > 1, correct for any sp.
+    - "flash": the fused Pallas flash kernel
+      (`ops/flash_attention.py`) — sp must be 1 (sequence unsharded);
+      fastest single-device path on TPU.
+    """
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0):
+                 seed: int = 0, attn: str = "ring"):
         assert mesh.axis_names == ("dp", "sp")
         self.cfg = cfg
         self.mesh = mesh
@@ -55,7 +63,13 @@ class ContextParallelEngine:
         self.opt_state = jax.device_put(optimizer.init(self.params), self.rep)
 
         opt = optimizer
-        attn = partial(ring_attention, axis_name="sp", causal=True)
+        if attn == "flash":
+            from shallowspeed_tpu.ops.flash_attention import flash_attention
+
+            assert self.sp == 1, "--attn flash requires sp=1 (use ring)"
+            attn = partial(flash_attention, causal=True)
+        else:
+            attn = partial(ring_attention, axis_name="sp", causal=True)
 
         def local_loss(params, tokens, targets):
             t_local = tokens.shape[1]

@@ -40,7 +40,7 @@ def test_forward_shapes_and_loss_finite():
     loss = T.loss(params, tokens, targets, CFG)
     assert np.isfinite(float(loss))
     # untrained loss ~ log(vocab)
-    assert abs(float(loss) - np.log(CFG.vocab)) < 0.5
+    assert abs(float(loss) - np.log(CFG.vocab)) < 1.0
 
 
 @pytest.mark.parametrize("dp,sp", [(1, 1), (2, 1), (1, 4), (2, 4)])
@@ -71,6 +71,19 @@ def test_context_parallel_training_learns():
         eng.train_batch(tokens, targets)
     last = eng.eval_loss(tokens, targets)
     assert last < first * 0.5, (first, last)
+
+
+def test_flash_engine_matches_ring_engine():
+    """attn='flash' (Pallas kernel) trains identically to attn='ring'."""
+    tokens, targets = toy_batch()
+    ring = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 1), seed=3)
+    flash = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 1), seed=3,
+                                  attn="flash")
+    for b in range(2):
+        tok, tgt = toy_batch(seed=b)
+        lr = ring.train_batch(tok, tgt)
+        lf = flash.train_batch(tok, tgt)
+        assert abs(lr - lf) < 1e-5, (lr, lf)
 
 
 def test_logits_match_full_attention_reference():

@@ -27,6 +27,9 @@ def parse_args(argv=None):
     p.add_argument("--dp", type=int, default=1, help="data-parallel degree")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence/context-parallel degree (ring attention)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree (Megatron placement via "
+                        "GSPMD); exclusive with --sp for now")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--d-model", type=int, default=128)
@@ -36,6 +39,9 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--optimizer", default="adam",
                    choices=["sgd", "momentum", "adam"])
+    p.add_argument("--attn", default="ring", choices=["ring", "flash"],
+                   help="attention substrate: ring (any --sp) or the fused "
+                        "Pallas flash kernel (--sp 1 only)")
     p.add_argument("--text", type=str, default="",
                    help="train on this UTF-8 text file (byte-level vocab)")
     p.add_argument("--seed", type=int, default=0)
@@ -80,10 +86,18 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
 
+    if args.sp > 1 and args.tp > 1:
+        raise SystemExit("--sp and --tp cannot be combined yet; pick one "
+                         "model-parallel axis (both compose with --dp)")
+    if args.tp > 1 and args.attn != "ring":
+        raise SystemExit("--attn flash is not available with --tp "
+                         "(the GSPMD engine uses XLA attention)")
+    model_par = args.tp if args.tp > 1 else args.sp
     n_dev = len(jax.devices())
-    if args.dp * args.sp > n_dev:
-        raise SystemExit(f"requested dp*sp={args.dp * args.sp} devices "
-                         f"but only {n_dev} present")
+    if args.dp * model_par > n_dev:
+        raise SystemExit(f"requested dp*{'tp' if args.tp > 1 else 'sp'}="
+                         f"{args.dp * model_par} devices but only "
+                         f"{n_dev} present")
     assert args.batch_size % args.dp == 0
     assert args.seq_len % args.sp == 0
 
@@ -91,10 +105,17 @@ def train(args) -> float:
     cfg = TransformerConfig(vocab=vocab, d_model=args.d_model,
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             max_seq=args.seq_len)
-    mesh = Mesh(np.array(jax.devices()[: args.dp * args.sp])
-                .reshape(args.dp, args.sp), ("dp", "sp"))
     opt = OPTIMIZERS[args.optimizer](lr=args.lr)
-    engine = ContextParallelEngine(cfg, opt, mesh, seed=args.seed)
+    devs = np.array(jax.devices()[: args.dp * model_par])
+    if args.tp > 1:
+        from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
+
+        mesh = Mesh(devs.reshape(args.dp, args.tp), ("dp", "tp"))
+        engine = TensorParallelEngine(cfg, opt, mesh, seed=args.seed)
+    else:
+        mesh = Mesh(devs.reshape(args.dp, args.sp), ("dp", "sp"))
+        engine = ContextParallelEngine(cfg, opt, mesh, seed=args.seed,
+                                       attn=args.attn)
 
     start_step = 0
     if args.resume:
