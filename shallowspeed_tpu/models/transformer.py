@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shallowspeed_tpu.ops.attention import attention
+from shallowspeed_tpu.ops.moe import moe_ffn
 
 
 @dataclass(frozen=True)
@@ -38,6 +39,13 @@ class TransformerConfig:
     n_layers: int = 2
     max_seq: int = 1024
     dtype: np.dtype = np.float32
+    # Mixture-of-experts (0 = dense FFN everywhere). With n_experts > 0 every
+    # block's FFN becomes a top-k routed MoE (`ops/moe.py`) — the family the
+    # reference lacks entirely (SURVEY §2: EP absent).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 1e-2
 
     @property
     def head_dim(self) -> int:
@@ -58,14 +66,25 @@ def init(cfg: TransformerConfig, seed: int = 0):
     d = cfg.d_model
     blocks = []
     for _ in range(cfg.n_layers):
-        blocks.append({
+        blk = {
             "ln1": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
             "qkv": _dense_init(rng, d, 3 * d, dt),
             "proj": _dense_init(rng, d, d, dt),
             "ln2": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
-            "up": _dense_init(rng, d, 4 * d, dt),
-            "down": _dense_init(rng, 4 * d, d, dt),
-        })
+        }
+        if cfg.n_experts > 0:
+            e, ff = cfg.n_experts, 4 * d
+            blk["moe"] = {
+                "gate": rng.normal(0.0, 0.02, (d, e)).astype(dt),
+                "wi": rng.normal(0.0, 1.0 / np.sqrt(d), (e, d, ff)).astype(dt),
+                "bi": np.zeros((e, ff), dt),
+                "wo": rng.normal(0.0, 1.0 / np.sqrt(ff), (e, ff, d)).astype(dt),
+                "bo": np.zeros((e, d), dt),
+            }
+        else:
+            blk["up"] = _dense_init(rng, d, 4 * d, dt)
+            blk["down"] = _dense_init(rng, 4 * d, d, dt)
+        blocks.append(blk)
     return {
         "tok_emb": rng.normal(0.0, 0.02, (cfg.vocab, d)).astype(dt),
         "pos_emb": rng.normal(0.0, 0.02, (cfg.max_seq, d)).astype(dt),
@@ -86,6 +105,8 @@ def _dense(p, x):
 
 
 def _block(p, x, cfg: TransformerConfig, attn_fn):
+    """One pre-LN block; returns (x, aux) where aux is the MoE
+    load-balancing loss (0.0 for dense blocks)."""
     b, t, d = x.shape
     h = _layernorm(p["ln1"], x)
     # head-major fused layout (H, 3, D): a contiguous slice of the 3d output
@@ -97,12 +118,15 @@ def _block(p, x, cfg: TransformerConfig, attn_fn):
     a = attn_fn(q, k, v).reshape(b, t, d)
     x = x + _dense(p["proj"], a)
     h = _layernorm(p["ln2"], x)
-    return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h)))
+    if "moe" in p:
+        y, aux = moe_ffn(p["moe"], h, cfg.moe_top_k, cfg.moe_capacity_factor)
+        return x + y, aux
+    return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h))), 0.0
 
 
-def forward(params, tokens, cfg: TransformerConfig,
-            attn_fn=None, pos_offset=0):
-    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab).
+def forward_with_aux(params, tokens, cfg: TransformerConfig,
+                     attn_fn=None, pos_offset=0):
+    """tokens: (batch, seq) int32 -> (logits (batch, seq, vocab), moe aux).
 
     `attn_fn(q, k, v)` defaults to full causal attention; a context-parallel
     caller passes `partial(ring_attention, axis_name='sp')` and the global
@@ -121,21 +145,30 @@ def forward(params, tokens, cfg: TransformerConfig,
             f"max_seq={cfg.max_seq}")
     pos = pos_offset + jnp.arange(t)
     x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    aux_total = 0.0
     for blk in params["blocks"]:
-        x = _block(blk, x, cfg, attn_fn)
+        x, aux = _block(blk, x, cfg, attn_fn)
+        aux_total = aux_total + aux
     x = _layernorm(params["ln_f"], x)
-    return _dense(params["head"], x)
+    return _dense(params["head"], x), aux_total
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            attn_fn=None, pos_offset=0):
+    """Logits only (see `forward_with_aux` for the MoE aux loss)."""
+    return forward_with_aux(params, tokens, cfg, attn_fn, pos_offset)[0]
 
 
 def loss(params, tokens, targets, cfg: TransformerConfig,
          attn_fn=None, pos_offset=0):
-    """Mean softmax cross-entropy over all (batch, seq) positions.
+    """Mean softmax cross-entropy over all (batch, seq) positions, plus the
+    weighted MoE load-balancing aux loss when the config has experts.
 
     Under data/sequence sharding the mean over the LOCAL block is returned;
     the caller averages across shards (`lax.pmean`) — exact because all
     blocks have equal size.
     """
-    logits = forward(params, tokens, cfg, attn_fn, pos_offset)
+    logits, aux = forward_with_aux(params, tokens, cfg, attn_fn, pos_offset)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return nll.mean() + cfg.moe_aux_weight * aux

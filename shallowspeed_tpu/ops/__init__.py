@@ -12,3 +12,8 @@ from shallowspeed_tpu.ops.attention import (  # noqa: F401
     attention,
     ring_attention,
 )
+from shallowspeed_tpu.ops.moe import (  # noqa: F401
+    expert_capacity,
+    moe_ffn,
+    topk_capacity_routing,
+)

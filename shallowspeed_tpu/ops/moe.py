@@ -1,0 +1,106 @@
+"""Mixture-of-experts ops: capacity-based top-k routing + einsum dispatch.
+
+The reference has no MoE / expert parallelism (SURVEY §2 parallelism
+checklist: EP absent); this module adds the family the TPU-native way — the
+GShard/Switch formulation rather than gather/scatter token shuffling:
+
+- **Static shapes everywhere.** Each expert processes a fixed-capacity
+  buffer of `C` token slots per batch group; routing produces dense
+  `dispatch`/`combine` tensors `(G, S, E, C)` and the actual token movement
+  is two einsums. Nothing here has data-dependent shapes, so the whole layer
+  jits, vmaps, and shards like any matmul stack.
+- **Expert parallelism is a placement decision.** Stacked expert weights
+  `(E, d, ff)` shard over an `ep` mesh axis via `PartitionSpec('ep', ...)`;
+  the dispatch einsum's output `(E, G, C, d)` is likewise `ep`-sharded, and
+  GSPMD lowers the resharding between the token-sharded and expert-sharded
+  layouts to the all-to-all collective that NCCL-style frameworks hand-code
+  (see `parallel/expert.py`).
+- **Load balancing** uses the standard Switch-Transformer auxiliary loss
+  (fraction-routed x mean-probability per expert, scaled by E).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(seq_len: int, num_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Token slots per expert per batch group (static)."""
+    return max(1, math.ceil(top_k * seq_len * capacity_factor / num_experts))
+
+
+def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
+                          top_k: int = 2):
+    """GShard-style top-k routing with per-expert capacity.
+
+    gate_logits: (G, S, E) — G batch groups of S tokens over E experts.
+
+    Returns:
+      combine:  (G, S, E, C) float32 — combine[g, s, e, c] is token (g, s)'s
+                gate weight on expert e's slot c (0 if not routed there).
+      dispatch: (G, S, E, C) bool — nonzero support of `combine`.
+      aux:      scalar load-balancing loss (Switch formulation).
+
+    Tokens beyond an expert's capacity are dropped for that expert (their
+    gate weight contributes nothing) — the standard static-shape tradeoff.
+    Positions are assigned in sequence order per expert, with later k
+    choices stacked after all earlier-k assignments (GShard's ordering).
+    """
+    g, s, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    # Top-k expert choices per token, gates renormalized over the chosen k.
+    topk_gate, topk_idx = jax.lax.top_k(probs, top_k)          # (G, S, K)
+    topk_gate = topk_gate / (topk_gate.sum(-1, keepdims=True) + 1e-9)
+
+    combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+    used = jnp.zeros((g, e), jnp.float32)  # slots consumed by earlier k
+    for k in range(top_k):
+        onehot = jax.nn.one_hot(topk_idx[..., k], e)            # (G, S, E)
+        # Position of each token within its expert's buffer: tokens assigned
+        # earlier in the sequence (or by an earlier k) occupy lower slots.
+        pos = jnp.cumsum(onehot, axis=1) - onehot + used[:, None, :]
+        keep = onehot * (pos < capacity)                        # (G, S, E)
+        slot = jax.nn.one_hot((pos * onehot).sum(-1).astype(jnp.int32),
+                              capacity)                         # (G, S, C)
+        combine = combine + (topk_gate[..., k, None, None]
+                             * keep[..., None] * slot[:, :, None, :])
+        used = used + keep.sum(axis=1)
+    dispatch = combine > 0.0
+
+    # Switch aux loss on the top-1 assignment: E * sum_e f_e * P_e, where
+    # f_e = fraction of tokens whose first choice is e, P_e = mean prob.
+    top1 = jax.nn.one_hot(topk_idx[..., 0], e)
+    aux = e * jnp.sum(top1.mean(axis=(0, 1)) * probs.mean(axis=(0, 1)))
+    return combine, dispatch, aux
+
+
+def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
+    """Mixture-of-experts feed-forward layer (drop-in for the dense GELU MLP).
+
+    p: {"gate": (d, E), "wi": (E, d, ff), "bi": (E, ff),
+        "wo": (E, ff, d), "bo": (E, d)}
+    x: (G, S, d) -> (y (G, S, d), aux scalar)
+
+    The two routing einsums below are where expert parallelism happens: with
+    `wi`/`wo` sharded `P('ep', ...)` and `x` sharded over batch, GSPMD turns
+    the (G,S,·)->(E,G,C,·) layout change into an all-to-all over 'ep'.
+    """
+    g, s, d = x.shape
+    e = p["gate"].shape[1]
+    cap = expert_capacity(s, e, top_k, capacity_factor)
+
+    logits = x @ p["gate"]                                     # (G, S, E)
+    combine, dispatch, aux = topk_capacity_routing(logits, cap, top_k)
+
+    xin = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)
+    h = jax.nn.gelu(jnp.einsum("egcd,edf->egcf", xin, p["wi"])
+                    + p["bi"][:, None, None, :])
+    out = (jnp.einsum("egcf,efd->egcd", h, p["wo"])
+           + p["bo"][:, None, None, :])
+    y = jnp.einsum("gsec,egcd->gsd", combine.astype(x.dtype), out)
+    return y, aux

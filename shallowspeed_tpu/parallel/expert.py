@@ -1,0 +1,60 @@
+"""Expert parallelism — MoE transformer sharded over a (dp, ep) mesh.
+
+The reference has no MoE / expert parallelism (SURVEY §2 checklist: EP
+absent); this engine adds the family in the GSPMD style the other engines
+use (`parallel/gspmd.py`): pick a mesh, annotate shardings, let XLA insert
+the collectives.
+
+Placement:
+- Stacked expert weights `wi/bi/wo/bo` (leading dim E): `P('ep', ...)` —
+  each device group owns `E/ep` experts.
+- Router gate, attention, embeddings, layernorms: replicated.
+- Batch over 'dp'.
+
+The MoE layer's dispatch einsum (`ops/moe.py`) maps token-sharded
+activations `(G, S, d)` onto the expert-sharded buffer `(E, G, C, d)`;
+GSPMD lowers that resharding to the all-to-all over 'ep' that NCCL-based
+MoE frameworks (DeepSpeed-MoE, Tutel) issue by hand, and schedules it
+against the expert matmuls.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.parallel.gspmd import GSPMDEngine
+
+
+def param_specs(cfg: T.TransformerConfig) -> dict:
+    """PartitionSpec pytree matching `transformer.init` with n_experts>0."""
+    assert cfg.n_experts > 0
+    dense = {"W": P(), "b": P()}
+    ln = {"g": P(), "b": P()}
+    moe = {"gate": P(), "wi": P("ep", None, None), "bi": P("ep", None),
+           "wo": P("ep", None, None), "bo": P("ep", None)}
+    block = {"ln1": ln, "qkv": dense, "proj": dense, "ln2": ln, "moe": moe}
+    return {
+        "tok_emb": P(),
+        "pos_emb": P(),
+        "blocks": [block for _ in range(cfg.n_layers)],
+        "ln_f": ln,
+        "head": dense,
+    }
+
+
+class ExpertParallelEngine(GSPMDEngine):
+    """Data x expert parallel trainer for the MoE transformer family."""
+
+    def validate(self, cfg: T.TransformerConfig, mesh: Mesh) -> None:
+        assert mesh.axis_names == ("dp", "ep")
+        assert cfg.n_experts > 0, "ExpertParallelEngine needs n_experts > 0"
+        self.ep = mesh.devices.shape[1]
+        assert cfg.n_experts % self.ep == 0, (
+            f"n_experts={cfg.n_experts} must be divisible by ep={self.ep}")
+        assert cfg.moe_top_k <= cfg.n_experts, (
+            f"moe_top_k={cfg.moe_top_k} cannot exceed "
+            f"n_experts={cfg.n_experts}")
+
+    def param_specs(self, cfg: T.TransformerConfig) -> dict:
+        return param_specs(cfg)

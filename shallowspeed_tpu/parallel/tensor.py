@@ -21,20 +21,16 @@ The model code (`models/transformer.py`) is untouched — tensor parallelism
 here is purely a *placement* decision, which is exactly the property that
 makes the GSPMD formulation composable and compiler-optimizable (collective
 scheduling, fusion with producers/consumers) in ways hand-rolled NCCL-style
-code is not.
+code is not. The training loop / checkpoint plumbing is shared with the
+other GSPMD engines (`parallel/gspmd.py`).
 """
 
 from __future__ import annotations
 
-from functools import partial
-
-import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from shallowspeed_tpu.models import transformer as T
-
-tree_map = jax.tree_util.tree_map
+from shallowspeed_tpu.parallel.gspmd import GSPMDEngine
 
 
 def param_specs(cfg: T.TransformerConfig) -> dict:
@@ -53,89 +49,18 @@ def param_specs(cfg: T.TransformerConfig) -> dict:
     }
 
 
-class TensorParallelEngine:
+class TensorParallelEngine(GSPMDEngine):
     """Data x tensor parallel trainer for the transformer LM family."""
 
-    def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0):
+    def validate(self, cfg: T.TransformerConfig, mesh: Mesh) -> None:
         assert mesh.axis_names == ("dp", "tp")
-        self.cfg = cfg
-        self.mesh = mesh
-        self.dp, self.tp = mesh.devices.shape
+        self.tp = mesh.devices.shape[1]
         assert cfg.n_heads % self.tp == 0, (
             f"n_heads={cfg.n_heads} must be divisible by tp={self.tp}")
         assert (4 * cfg.d_model) % self.tp == 0
-        self.optimizer = optimizer
+        assert cfg.n_experts == 0, (
+            "TensorParallelEngine shards the dense FFN; use "
+            "ExpertParallelEngine for MoE configs")
 
-        self.shardings = tree_map(
-            lambda s: NamedSharding(mesh, s), param_specs(cfg),
-            is_leaf=lambda x: isinstance(x, P))
-        self.rep = NamedSharding(mesh, P())
-        self.batch = NamedSharding(mesh, P("dp", None))
-
-        self.params = jax.device_put(T.init(cfg, seed), self.shardings)
-        # zeros_like preserves sharding, so optimizer moments inherit the
-        # Megatron placement with no extra spec bookkeeping; leaves created
-        # fresh (e.g. Adam's step counter) get pinned replicated.
-        self.opt_state = tree_map(self._mesh_or_replicated,
-                                  optimizer.init(self.params))
-
-        opt = optimizer
-
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def _step(params, opt_state, tokens, targets):
-            loss, grads = jax.value_and_grad(
-                lambda p: T.loss(p, tokens, targets, cfg))(params)
-            params, opt_state = opt.step(params, grads, opt_state)
-            return params, opt_state, loss
-
-        self._step_fn = _step
-        self._eval_fn = jax.jit(
-            lambda p, tok, tgt: T.loss(p, tok, tgt, cfg))
-        self._logits_fn = jax.jit(
-            lambda p, tok: T.forward(p, tok, cfg))
-
-    def _mesh_or_replicated(self, leaf):
-        """Keep a leaf's mesh placement if it has one; replicate otherwise."""
-        if isinstance(getattr(leaf, "sharding", None), NamedSharding):
-            return leaf
-        return jax.device_put(leaf, self.rep)
-
-    def _place(self, arr: np.ndarray):
-        assert arr.shape[0] % self.dp == 0, (arr.shape, self.dp)
-        assert arr.shape[1] <= self.cfg.max_seq
-        return jax.device_put(arr, self.batch)
-
-    def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state,
-            self._place(tokens), self._place(targets))
-        return float(loss)
-
-    def eval_loss(self, tokens: np.ndarray, targets: np.ndarray) -> float:
-        return float(self._eval_fn(
-            self.params, self._place(tokens), self._place(targets)))
-
-    def logits(self, tokens: np.ndarray) -> jax.Array:
-        return self._logits_fn(self.params, self._place(tokens))
-
-    # -------------------------------------------- checkpoint interface
-
-    def get_canonical_params(self):
-        return self.params
-
-    def set_canonical_params(self, params):
-        self.params = jax.device_put(
-            jax.device_get(params), self.shardings)
-
-    def set_opt_state(self, state):
-        # re-place moments onto the Megatron shardings (state trees mirror
-        # params for SGD-momentum / Adam's m and v; scalars go replicated);
-        # the live opt_state is the placement template — same structure,
-        # no transient duplicate allocation.
-        def place(leaf, like):
-            sh = getattr(like, "sharding", None)
-            sh = sh if isinstance(sh, NamedSharding) else self.rep
-            return jax.device_put(np.asarray(leaf), sh)
-
-        self.opt_state = tree_map(place, state, self.opt_state)
+    def param_specs(self, cfg: T.TransformerConfig) -> dict:
+        return param_specs(cfg)

@@ -30,6 +30,12 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree (Megatron placement via "
                         "GSPMD); exclusive with --sp for now")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (requires --experts > 0); "
+                        "exclusive with --sp/--tp for now")
+    p.add_argument("--experts", type=int, default=0,
+                   help="number of MoE experts per block (0 = dense FFN)")
+    p.add_argument("--moe-top-k", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--d-model", type=int, default=128)
@@ -86,16 +92,27 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
 
-    if args.sp > 1 and args.tp > 1:
-        raise SystemExit("--sp and --tp cannot be combined yet; pick one "
-                         "model-parallel axis (both compose with --dp)")
+    if sum(ax > 1 for ax in (args.sp, args.tp, args.ep)) > 1:
+        raise SystemExit("--sp/--tp/--ep cannot be combined yet; pick one "
+                         "model-parallel axis (each composes with --dp)")
     if args.tp > 1 and args.attn != "ring":
         raise SystemExit("--attn flash is not available with --tp "
                          "(the GSPMD engine uses XLA attention)")
-    model_par = args.tp if args.tp > 1 else args.sp
+    if args.ep > 1 and args.experts == 0:
+        raise SystemExit("--ep requires --experts > 0")
+    if args.experts and (args.sp > 1 or args.tp > 1):
+        raise SystemExit("--experts composes with --dp/--ep only (not "
+                         "--sp/--tp) for now")
+    if args.experts and args.moe_top_k > args.experts:
+        raise SystemExit(f"--moe-top-k {args.moe_top_k} cannot exceed "
+                         f"--experts {args.experts}")
+    if args.experts and args.attn != "ring":
+        raise SystemExit("--attn flash is not available with --experts "
+                         "(the MoE engine uses XLA attention)")
+    model_par = max(args.tp, args.sp, args.ep)
     n_dev = len(jax.devices())
     if args.dp * model_par > n_dev:
-        raise SystemExit(f"requested dp*{'tp' if args.tp > 1 else 'sp'}="
+        raise SystemExit(f"requested dp*model_parallel="
                          f"{args.dp * model_par} devices but only "
                          f"{n_dev} present")
     assert args.batch_size % args.dp == 0
@@ -104,10 +121,16 @@ def train(args) -> float:
     vocab = 256
     cfg = TransformerConfig(vocab=vocab, d_model=args.d_model,
                             n_heads=args.n_heads, n_layers=args.n_layers,
-                            max_seq=args.seq_len)
+                            max_seq=args.seq_len, n_experts=args.experts,
+                            moe_top_k=args.moe_top_k)
     opt = OPTIMIZERS[args.optimizer](lr=args.lr)
     devs = np.array(jax.devices()[: args.dp * model_par])
-    if args.tp > 1:
+    if args.ep > 1 or args.experts:
+        from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
+
+        mesh = Mesh(devs.reshape(args.dp, args.ep), ("dp", "ep"))
+        engine = ExpertParallelEngine(cfg, opt, mesh, seed=args.seed)
+    elif args.tp > 1:
         from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.tp), ("dp", "tp"))
