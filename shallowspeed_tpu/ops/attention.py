@@ -16,6 +16,14 @@ is first-class in this framework, built the TPU way:
   attention over the gathered sequence, with O(T_local) memory and
   compute/communication overlap (the ppermute of step i+1's block overlaps
   the einsums of step i under XLA's latency-hiding scheduler).
+- `ulysses_attention`: DeepSpeed-Ulysses-style all-to-all sequence
+  parallelism over the same sequence-sharded axis. Two `lax.all_to_all`s
+  re-shard (seq-sharded, all heads) -> (all seq, head-sharded) so each
+  device runs plain full attention for its head subset, then the reverse
+  all-to-all restores sequence sharding. Requires heads % axis_size == 0;
+  comm volume is O(T·d/n) per device per all-to-all (vs the ring's n
+  ppermute hops of K/V) and the attention itself is the single fused XLA
+  program — the better choice when heads >= devices and T is moderate.
 
 Both are differentiable with `jax.grad` (the transformer family uses JAX
 autodiff as its autograd, unlike the MLP family's hand-written VJPs that
@@ -50,6 +58,38 @@ def attention(q: Array, k: Array, v: Array, causal: bool = True) -> Array:
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,
+                      causal: bool = True) -> Array:
+    """All-to-all (Ulysses) attention over the sequence-sharded `axis_name`.
+
+    q, k, v: (batch, seq_local, heads, head_dim) — this device's sequence
+    block, same contract as `ring_attention`. Returns this device's
+    (batch, seq_local, heads, head_dim) output, equal (up to float
+    reassociation) to slicing full `attention` over the gathered sequence.
+
+    The first all-to-all turns the sequence sharding into a *head* sharding
+    (each device receives every sequence block for heads
+    [idx*h/n, (idx+1)*h/n)); `tiled=True` concatenates received blocks in
+    mesh-axis order, so the gathered sequence axis is already in global
+    order and the plain causal mask is correct. After local full attention,
+    the reverse all-to-all restores sequence sharding.
+    """
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    assert h % n == 0, (
+        f"ulysses_attention needs heads ({h}) divisible by the "
+        f"'{axis_name}' axis size ({n}); use ring_attention otherwise")
+
+    def gather_seq(x):  # (b, t/n, h, d) -> (b, t, h/n, d)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    o = attention(gather_seq(q), gather_seq(k), gather_seq(v), causal=causal)
+    # (b, t, h/n, d) -> (b, t/n, h, d)
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
 
 
 def ring_attention(q: Array, k: Array, v: Array, axis_name: str,

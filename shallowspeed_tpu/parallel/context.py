@@ -35,15 +35,18 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from shallowspeed_tpu.models import transformer as T
-from shallowspeed_tpu.ops.attention import ring_attention
+from shallowspeed_tpu.ops.attention import ring_attention, ulysses_attention
 
 
 class ContextParallelEngine:
     """Data x sequence parallel trainer for the transformer LM family.
 
     `attn` selects the attention substrate:
-    - "ring" (default): `ring_attention` over the 'sp' axis — required for
-      sp > 1, correct for any sp.
+    - "ring" (default): `ring_attention` over the 'sp' axis — correct for
+      any sp, O(T_local) memory, n ppermute hops.
+    - "ulysses": `ulysses_attention` — all-to-all head<->sequence
+      re-sharding around one fused full-attention program; needs
+      n_heads % sp == 0.
     - "flash": the fused Pallas flash kernel
       (`ops/flash_attention.py`) — sp must be 1 (sequence unsharded);
       fastest single-device path on TPU.
@@ -68,6 +71,11 @@ class ContextParallelEngine:
 
             assert self.sp == 1, "--attn flash requires sp=1 (use ring)"
             attn = partial(flash_attention, causal=True)
+        elif attn == "ulysses":
+            assert cfg.n_heads % self.sp == 0, (
+                f"--attn ulysses needs n_heads ({cfg.n_heads}) divisible by "
+                f"sp ({self.sp}); use ring")
+            attn = partial(ulysses_attention, axis_name="sp", causal=True)
         else:
             attn = partial(ring_attention, axis_name="sp", causal=True)
 

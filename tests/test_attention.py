@@ -18,7 +18,8 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from shallowspeed_tpu.ops.attention import attention, ring_attention
+from shallowspeed_tpu.ops.attention import (attention, ring_attention,
+                                            ulysses_attention)
 
 B, T, H, D = 2, 32, 4, 16
 
@@ -94,6 +95,57 @@ def test_ring_gradients_match_full(qkv):
     for gf, gr in zip(g_full, g_ring):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
                                    rtol=5e-4, atol=5e-5)
+
+
+def ulysses_on_mesh(q, k, v, sp, causal):
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+    spec = P(None, "sp")
+    fn = shard_map(
+        partial(ulysses_attention, axis_name="sp", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return np.asarray(jax.jit(fn)(q, k, v))
+
+
+@pytest.mark.parametrize("sp", [1, 2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_full(qkv, sp, causal):
+    """All-to-all sequence parallelism must equal full attention (H=4, so
+    sp in {1,2,4} covers heads-per-device in {4,2,1})."""
+    q, k, v = qkv
+    want = np.asarray(attention(q, k, v, causal=causal))
+    got = ulysses_on_mesh(q, k, v, sp, causal)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gradients_match_full(qkv):
+    """jax.grad straight through the two all-to-alls must equal the
+    full-attention gradient."""
+    q, k, v = qkv
+    sp = 4
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+    spec = P(None, "sp")
+
+    def full_loss(q, k, v):
+        return (attention(q, k, v, causal=True) ** 2).sum()
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=P())
+    def uly_loss(q, k, v):
+        o = ulysses_attention(q, k, v, axis_name="sp", causal=True)
+        return jax.lax.psum((o.astype(jnp.float32) ** 2).sum(), "sp")
+
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    g_uly = jax.jit(jax.grad(uly_loss, argnums=(0, 1, 2)))(q, k, v)
+    for gf, gu in zip(g_full, g_uly):
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gf),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(qkv):
+    """H=4 over sp=8 cannot shard heads; the op must refuse loudly."""
+    q, k, v = qkv
+    with pytest.raises(Exception, match="divisible"):
+        ulysses_on_mesh(q, k, v, sp=8, causal=True)
 
 
 def test_ring_long_sequence_small_blocks():

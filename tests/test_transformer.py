@@ -86,6 +86,24 @@ def test_flash_engine_matches_ring_engine():
         assert abs(lr - lf) < 1e-5, (lr, lf)
 
 
+def test_ulysses_engine_matches_ring_engine():
+    """attn='ulysses' (all-to-all SP) trains identically to attn='ring'
+    on a sequence-sharded (dp=2, sp=2) mesh."""
+    ring = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 2), seed=3)
+    uly = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 2), seed=3,
+                                attn="ulysses")
+    for b in range(2):
+        tok, tgt = toy_batch(seed=b)
+        lr = ring.train_batch(tok, tgt)
+        lu = uly.train_batch(tok, tgt)
+        assert abs(lr - lu) < 1e-5, (lr, lu)
+    flat_r = jax.tree_util.tree_leaves(ring.params)
+    flat_u = jax.tree_util.tree_leaves(uly.params)
+    for a, b in zip(flat_r, flat_u):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_logits_match_full_attention_reference():
     """Sharded inference logits == direct full-attention forward."""
     eng = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 4), seed=9)

@@ -45,9 +45,11 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--optimizer", default="adam",
                    choices=["sgd", "momentum", "adam"])
-    p.add_argument("--attn", default="ring", choices=["ring", "flash"],
-                   help="attention substrate: ring (any --sp) or the fused "
-                        "Pallas flash kernel (--sp 1 only)")
+    p.add_argument("--attn", default="ring",
+                   choices=["ring", "ulysses", "flash"],
+                   help="attention substrate: ring (any --sp), ulysses "
+                        "(all-to-all; needs n_heads %% sp == 0) or the "
+                        "fused Pallas flash kernel (--sp 1 only)")
     p.add_argument("--text", type=str, default="",
                    help="train on this UTF-8 text file (byte-level vocab)")
     p.add_argument("--seed", type=int, default=0)
@@ -96,7 +98,7 @@ def train(args) -> float:
         raise SystemExit("--sp/--tp/--ep cannot be combined yet; pick one "
                          "model-parallel axis (each composes with --dp)")
     if args.tp > 1 and args.attn != "ring":
-        raise SystemExit("--attn flash is not available with --tp "
+        raise SystemExit(f"--attn {args.attn} is not available with --tp "
                          "(the GSPMD engine uses XLA attention)")
     if args.ep > 1 and args.experts == 0:
         raise SystemExit("--ep requires --experts > 0")
@@ -107,8 +109,8 @@ def train(args) -> float:
         raise SystemExit(f"--moe-top-k {args.moe_top_k} cannot exceed "
                          f"--experts {args.experts}")
     if args.experts and args.attn != "ring":
-        raise SystemExit("--attn flash is not available with --experts "
-                         "(the MoE engine uses XLA attention)")
+        raise SystemExit(f"--attn {args.attn} is not available with "
+                         "--experts (the MoE engine uses XLA attention)")
     model_par = max(args.tp, args.sp, args.ep)
     n_dev = len(jax.devices())
     if args.dp * model_par > n_dev:
