@@ -17,6 +17,7 @@ flags) is the dp=1 special case.
 from __future__ import annotations
 
 from functools import partial
+from typing import Any
 
 import jax
 import numpy as np
@@ -91,26 +92,36 @@ class FusedDPEngine:
         def _infer(params, x):
             return stage_ref.infer(params, x)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        @partial(shard_map, mesh=mesh,
-                 in_specs=(P(), P(), P(None, "dp"), P(None, "dp")),
-                 out_specs=(P(), P()))
-        def _epoch(params, opt_state, xs, ys):
-            # xs: (n_batches, dp, n_mu, mubs, d) — whole epoch device-resident,
-            # one dispatch; HBM-residency is the TPU answer to the reference's
-            # per-microbatch host loads (`dataset.py:66-80`).
-            def batch_body(carry, xy):
-                p, o = carry
-                x, y = xy
-                return local_step(p, o, x[0], y[0]), None
+        def _make_run(n_epochs: int):
+            @partial(jax.jit, donate_argnums=(0, 1))
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P(), P(), P(None, "dp"), P(None, "dp")),
+                     out_specs=(P(), P()))
+            def _run(params, opt_state, xs, ys):
+                # xs: (n_batches, dp, n_mu, mubs, d) — whole run device-
+                # resident, ONE XLA dispatch: scan over epochs of (scan over
+                # batches of (scan over microbatches)). HBM-residency and
+                # fused dispatch are the TPU answer to the reference's
+                # per-microbatch host loads (`dataset.py:66-80`).
+                def batch_body(carry, xy):
+                    p, o = carry
+                    x, y = xy
+                    return local_step(p, o, x[0], y[0]), None
 
-            (params, opt_state), _ = jax.lax.scan(
-                batch_body, (params, opt_state), (xs, ys))
-            return params, opt_state
+                def epoch_body(carry, _):
+                    carry, _ = jax.lax.scan(batch_body, carry, (xs, ys))
+                    return carry, None
+
+                (params, opt_state), _ = jax.lax.scan(
+                    epoch_body, (params, opt_state), None, length=n_epochs)
+                return params, opt_state
+
+            return _run
 
         self._step = _step
         self._infer = _infer
-        self._epoch = _epoch
+        self._make_run = _make_run
+        self._run_cache: dict[int, Any] = {}
 
     # ------------------------------------------------------------- steps
 
@@ -143,9 +154,17 @@ class FusedDPEngine:
 
     def train_epoch(self, staged):
         """One dispatch for a full epoch over pre-staged device data."""
+        self.train_run(staged, 1)
+
+    def train_run(self, staged, n_epochs: int):
+        """One dispatch for a full n_epochs training run over pre-staged
+        device data (same epoch data each epoch, as the reference has no
+        shuffling — `dataset.py:66-80` indexes deterministically)."""
         xs, ys = staged
-        self.params, self.opt_state = self._epoch(
-            self.params, self.opt_state, xs, ys)
+        run = self._run_cache.get(n_epochs)
+        if run is None:
+            run = self._run_cache[n_epochs] = self._make_run(n_epochs)
+        self.params, self.opt_state = run(self.params, self.opt_state, xs, ys)
 
     # -------------------------------------------------- checkpoint interface
 

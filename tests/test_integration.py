@@ -128,6 +128,23 @@ def test_fused_epoch_matches_batch_sequence(data_dir):
         np.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-7)
 
 
+def test_fused_run_matches_epoch_loop(data_dir):
+    """train_run (one dispatch for N epochs) must equal N train_epoch
+    dispatches over the same staged data."""
+    mesh = make_mesh(2, 1)
+    a = FusedDPEngine(MLPStage(SIZES, 0, 1, batch_size=GBS), SGD(LR), mesh)
+    ds = make_datasets(data_dir, 2)
+    staged = a.stage_epoch(ds, 4)
+    for _ in range(3):
+        a.train_epoch(staged)
+
+    b = FusedDPEngine(MLPStage(SIZES, 0, 1, batch_size=GBS),
+                      SGD(LR), make_mesh(2, 1))
+    b.train_run(b.stage_epoch(ds, 4), 3)
+    for la, lb in zip(flat_params(a), flat_params(b)):
+        np.testing.assert_allclose(la, lb, rtol=1e-6, atol=1e-7)
+
+
 def test_vm_pp1_matches_fused(data_dir):
     fused = train_fused(data_dir, dp=1)
     vm = train_vm(data_dir, dp=1, pp=1, schedule_cls=NaiveParallelSchedule)
