@@ -53,7 +53,7 @@ class ContextParallelEngine:
     """
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0, attn: str = "ring"):
+                 seed: int = 0, attn: str = "ring", zero1: bool = False):
         assert mesh.axis_names == ("dp", "sp")
         self.cfg = cfg
         self.mesh = mesh
@@ -87,11 +87,7 @@ class ContextParallelEngine:
 
         n_tiles = self.dp * self.sp
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        @partial(shard_map, mesh=mesh,
-                 in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
-                 out_specs=(P(), P(), P()))
-        def _step(params, opt_state, tokens, targets):
+        def loss_and_grads(params, tokens, targets):
             # Params are mesh-invariant (replicated), the per-tile loss is
             # varying: jax.grad's transpose of that broadcast IS a psum over
             # ('dp','sp') — the gradient arrives already summed across tiles.
@@ -104,9 +100,39 @@ class ContextParallelEngine:
                 return local_loss(p, tokens, targets) / n_tiles
 
             lloc, grads = jax.value_and_grad(scaled)(params)
-            loss = jax.lax.pmean(lloc * n_tiles, ("dp", "sp"))
-            params, opt_state = opt.step(params, grads, opt_state)
-            return params, opt_state, loss
+            return jax.lax.pmean(lloc * n_tiles, ("dp", "sp")), grads
+
+        if zero1:
+            from shallowspeed_tpu.parallel.zero import (
+                make_zero1_update, shard_state_zero1)
+
+            @jax.jit
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P(), P("dp", "sp"), P("dp", "sp")),
+                     out_specs=(P(), P()))
+            def _loss_grads(params, tokens, targets):
+                # ZeRO-1 grad program: the grads leave the shard_map
+                # already psum'd (invariant), ready for the dp-sharded
+                # optimizer update.
+                return loss_and_grads(params, tokens, targets)
+
+            self.opt_state = shard_state_zero1(self.opt_state, mesh)
+            self._loss_grads_fn = _loss_grads
+            self._update_fn = make_zero1_update(
+                opt, self.params, self.opt_state)
+            self._step_fn = None
+        else:
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
+                     out_specs=(P(), P(), P()))
+            def _step(params, opt_state, tokens, targets):
+                loss, grads = loss_and_grads(params, tokens, targets)
+                params, opt_state = opt.step(params, grads, opt_state)
+                return params, opt_state, loss
+
+            self._step_fn = _step
 
         @jax.jit
         @partial(shard_map, mesh=mesh,
@@ -125,7 +151,6 @@ class ContextParallelEngine:
             return T.forward(params, tokens, cfg, attn_fn=attn,
                              pos_offset=off)
 
-        self._step_fn = _step
         self._eval_fn = _eval
         self._logits_fn = _logits
 
@@ -143,6 +168,12 @@ class ContextParallelEngine:
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         """One optimizer step on a (B, T) int token batch; returns the loss."""
+        if self._step_fn is None:  # ZeRO-1: grad program + sharded update
+            loss, grads = self._loss_grads_fn(
+                self.params, self._place(tokens), self._place(targets))
+            self.params, self.opt_state = self._update_fn(
+                self.params, grads, self.opt_state)
+            return float(loss)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state,
             self._place(tokens), self._place(targets))
@@ -164,4 +195,6 @@ class ContextParallelEngine:
         self.params = jax.device_put(params, self.rep)
 
     def set_opt_state(self, state):
-        self.opt_state = jax.device_put(state, self.rep)
+        from shallowspeed_tpu.parallel.zero import replace_opt_state
+
+        self.opt_state = replace_opt_state(self, state)

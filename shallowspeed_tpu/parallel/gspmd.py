@@ -27,7 +27,7 @@ class GSPMDEngine:
     axis), parameters placed per `self.param_specs(cfg)`."""
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0):
+                 seed: int = 0, zero1: bool = False):
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
@@ -49,14 +49,31 @@ class GSPMDEngine:
 
         opt = optimizer
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def _step(params, opt_state, tokens, targets):
-            loss, grads = jax.value_and_grad(
-                lambda p: T.loss(p, tokens, targets, cfg))(params)
-            params, opt_state = opt.step(params, grads, opt_state)
-            return params, opt_state, loss
+        if zero1:
+            from shallowspeed_tpu.parallel.zero import (
+                make_zero1_update, shard_state_zero1)
 
-        self._step_fn = _step
+            self.opt_state = shard_state_zero1(self.opt_state, mesh)
+
+            @jax.jit
+            def _grads(params, tokens, targets):
+                return jax.value_and_grad(
+                    lambda p: T.loss(p, tokens, targets, cfg))(params)
+
+            self._grads_fn = _grads
+            self._update_fn = make_zero1_update(
+                opt, self.params, self.opt_state)
+            self._step_fn = None
+        else:
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def _step(params, opt_state, tokens, targets):
+                loss, grads = jax.value_and_grad(
+                    lambda p: T.loss(p, tokens, targets, cfg))(params)
+                params, opt_state = opt.step(params, grads, opt_state)
+                return params, opt_state, loss
+
+            self._step_fn = _step
         self._eval_fn = jax.jit(
             lambda p, tok, tgt: T.loss(p, tok, tgt, cfg))
         self._logits_fn = jax.jit(
@@ -84,6 +101,12 @@ class GSPMDEngine:
         return jax.device_put(arr, self.batch)
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        if self._step_fn is None:  # ZeRO-1: grad program + sharded update
+            loss, grads = self._grads_fn(
+                self.params, self._place(tokens), self._place(targets))
+            self.params, self.opt_state = self._update_fn(
+                self.params, grads, self.opt_state)
+            return float(loss)
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state,
             self._place(tokens), self._place(targets))
@@ -106,13 +129,8 @@ class GSPMDEngine:
             jax.device_get(params), self.shardings)
 
     def set_opt_state(self, state):
-        # re-place moments onto the parameter shardings (state trees mirror
-        # params for SGD-momentum / Adam's m and v; scalars go replicated);
-        # the live opt_state is the placement template — same structure,
-        # no transient duplicate allocation.
-        def place(leaf, like):
-            sh = getattr(like, "sharding", None)
-            sh = sh if isinstance(sh, NamedSharding) else self.rep
-            return jax.device_put(np.asarray(leaf), sh)
+        # the live opt_state is the placement template — preserves param-
+        # inherited moment placement and ZeRO-1 dp-sharding alike.
+        from shallowspeed_tpu.parallel.zero import replace_opt_state
 
-        self.opt_state = tree_map(place, state, self.opt_state)
+        self.opt_state = replace_opt_state(self, state)

@@ -45,6 +45,10 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--optimizer", default="adam",
                    choices=["sgd", "momentum", "adam"])
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard optimizer state over the dp axis "
+                        "(1/dp per-device Adam moment footprint; GSPMD "
+                        "derives the reduce/all-gather pattern)")
     p.add_argument("--attn", default="ring",
                    choices=["ring", "ulysses", "flash"],
                    help="attention substrate: ring (any --sp), ulysses "
@@ -131,16 +135,18 @@ def train(args) -> float:
         from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.ep), ("dp", "ep"))
-        engine = ExpertParallelEngine(cfg, opt, mesh, seed=args.seed)
+        engine = ExpertParallelEngine(cfg, opt, mesh, seed=args.seed,
+                                      zero1=args.zero1)
     elif args.tp > 1:
         from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.tp), ("dp", "tp"))
-        engine = TensorParallelEngine(cfg, opt, mesh, seed=args.seed)
+        engine = TensorParallelEngine(cfg, opt, mesh, seed=args.seed,
+                                      zero1=args.zero1)
     else:
         mesh = Mesh(devs.reshape(args.dp, args.sp), ("dp", "sp"))
         engine = ContextParallelEngine(cfg, opt, mesh, seed=args.seed,
-                                       attn=args.attn)
+                                       attn=args.attn, zero1=args.zero1)
 
     start_step = 0
     if args.resume:
