@@ -4,57 +4,175 @@ Capability parity with the reference's stateless SGD
 (`/root/reference/shallowspeed/optimizer.py:4-13`, `param.data -= lr * grad`),
 re-designed functionally: `step(params, grads, state) -> (params, state)` is a
 pure function that jits and shards like any other part of the training step
-(optax-style, but self-contained). Momentum-SGD and Adam are additions beyond
-the reference surface.
+(optax-style, but self-contained). Momentum-SGD, Adam, AdamW, learning-rate
+schedules, and global-norm gradient clipping are additions beyond the
+reference surface.
+
+Every optimizer accepts `lr` as either a float or a schedule — a callable
+`t -> lr` evaluated on the (0-based) step counter carried in the optimizer
+state, traced into the compiled step so the schedule runs on-device. A
+`grad_clip` argument applies global-norm clipping before the update.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import math
+from typing import Any, Callable, Union
 
 import jax
 import jax.numpy as jnp
 
 tree_map = jax.tree_util.tree_map
 
+LR = Union[float, Callable[[jax.Array], jax.Array]]
 
-class SGD:
-    """Plain SGD. Reference: `optimizer.py:4-13`."""
+# ------------------------------------------------------------- schedules
 
-    def __init__(self, lr: float):
+
+def constant(peak: float, warmup: int = 0, total: int = 0, end: float = 0.0):
+    """Constant schedule. Signature-compatible with warmup_linear/
+    warmup_cosine (warmup/total/end accepted and ignored) so call sites can
+    construct any SCHEDULES entry uniformly."""
+    return lambda t: jnp.asarray(peak, jnp.float32)
+
+
+def warmup_linear(peak: float, warmup: int, total: int, end: float = 0.0):
+    """Linear 0 -> peak over `warmup` steps, then linear peak -> end at
+    `total` steps (clamped after)."""
+    def sched(t):
+        t = jnp.asarray(t, jnp.float32)
+        up = peak * t / max(warmup, 1)
+        frac = jnp.clip((t - warmup) / max(total - warmup, 1), 0.0, 1.0)
+        down = peak + (end - peak) * frac
+        return jnp.where(t < warmup, up, down)
+
+    return sched
+
+
+def warmup_cosine(peak: float, warmup: int, total: int, end: float = 0.0):
+    """Linear 0 -> peak over `warmup` steps, then cosine peak -> end at
+    `total` steps (clamped after). The standard LM-pretraining schedule."""
+    def sched(t):
+        t = jnp.asarray(t, jnp.float32)
+        up = peak * t / max(warmup, 1)
+        frac = jnp.clip((t - warmup) / max(total - warmup, 1), 0.0, 1.0)
+        down = end + (peak - end) * 0.5 * (1 + jnp.cos(math.pi * frac))
+        return jnp.where(t < warmup, up, down)
+
+    return sched
+
+
+SCHEDULES = {"constant": constant, "linear": warmup_linear,
+             "cosine": warmup_cosine}
+
+# -------------------------------------------------------------- clipping
+
+
+def global_norm(grads: Any, axes: tuple = ()) -> jax.Array:
+    """L2 norm over every leaf of the gradient pytree (f32 accumulation).
+
+    `axes`: mesh axis names to `lax.psum` the squared sum over — required
+    when called inside `shard_map` with grads *sharded* over those axes
+    (e.g. per-stage grads over 'pp' in the SPMD pipeline engine), so the
+    norm is the true global one, not the local shard's."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    if axes:
+        sq = jax.lax.psum(sq, axes)
+    return jnp.sqrt(sq)
+
+
+def clip_by_global_norm(grads: Any, max_norm: float,
+                        axes: tuple = ()) -> Any:
+    """Scale the whole pytree so its global norm is at most `max_norm`."""
+    norm = global_norm(grads, axes)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    return tree_map(lambda g: (g * scale).astype(g.dtype), grads)
+
+
+# ------------------------------------------------------------ optimizers
+
+
+class _Optimizer:
+    """Shared lr/schedule/clip plumbing.
+
+    `clip_axes` (class default `()`): mesh axis names whose shards must be
+    psum-combined for the clipping norm. Engines that trace `step` inside a
+    `shard_map` where grads are *sharded* (not invariant) set this on their
+    private copy of the optimizer (see `SPMDPipelineEngine`); with grads
+    replicated or under GSPMD-jit the default is already the global norm."""
+
+    clip_axes: tuple = ()
+
+    def __init__(self, lr: LR, grad_clip: float | None = None):
         self.lr = lr
+        self.grad_clip = grad_clip
+
+    def _lr_at(self, t) -> jax.Array:
+        if callable(self.lr):
+            return jnp.asarray(self.lr(t), jnp.float32)
+        return jnp.asarray(self.lr, jnp.float32)
+
+    def _prep(self, grads: Any) -> Any:
+        if self.grad_clip is not None:
+            return clip_by_global_norm(grads, self.grad_clip, self.clip_axes)
+        return grads
+
+
+class SGD(_Optimizer):
+    """Plain SGD. Reference: `optimizer.py:4-13`. Stateless with a static
+    lr (exactly the reference's shape); carries a step counter only when
+    driven by a schedule."""
 
     def init(self, params: Any) -> Any:
+        if callable(self.lr):
+            return {"t": jnp.zeros((), jnp.int32)}
         return ()
 
     def step(self, params: Any, grads: Any, state: Any = ()):
-        new = tree_map(lambda p, g: p - self.lr * g, params, grads)
-        return new, state
+        grads = self._prep(grads)
+        sched = callable(self.lr)
+        t = state["t"] if sched else jnp.zeros((), jnp.int32)
+        lr = self._lr_at(t)
+        new = tree_map(lambda p, g: p - lr * g, params, grads)
+        return new, ({"t": t + 1} if sched else state)
 
 
-class MomentumSGD:
+class MomentumSGD(_Optimizer):
     """SGD with classical momentum (addition beyond the reference)."""
 
-    def __init__(self, lr: float, momentum: float = 0.9):
-        self.lr = lr
+    def __init__(self, lr: LR, momentum: float = 0.9,
+                 grad_clip: float | None = None):
+        super().__init__(lr, grad_clip)
         self.momentum = momentum
 
     def init(self, params: Any) -> Any:
-        return tree_map(jnp.zeros_like, params)
+        vel = tree_map(jnp.zeros_like, params)
+        if callable(self.lr):
+            return {"v": vel, "t": jnp.zeros((), jnp.int32)}
+        return vel
 
     def step(self, params: Any, grads: Any, state: Any):
-        vel = tree_map(lambda v, g: self.momentum * v + g, state, grads)
-        new = tree_map(lambda p, v: p - self.lr * v, params, vel)
-        return new, vel
+        grads = self._prep(grads)
+        sched = callable(self.lr)
+        vel0 = state["v"] if sched else state
+        t = state["t"] if sched else jnp.zeros((), jnp.int32)
+        lr = self._lr_at(t)
+        vel = tree_map(lambda v, g: self.momentum * v + g, vel0, grads)
+        new = tree_map(lambda p, v: p - lr * v, params, vel)
+        return new, ({"v": vel, "t": t + 1} if sched else vel)
 
 
-class Adam:
+class Adam(_Optimizer):
     """Adam (addition; matches the reference's PyTorch-DDP baseline script,
     `scripts/DDP_PyTorch_MNIST.py`, which trains with torch Adam)."""
 
-    def __init__(self, lr: float, b1: float = 0.9, b2: float = 0.999,
-                 eps: float = 1e-8):
-        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+    def __init__(self, lr: LR, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8, grad_clip: float | None = None):
+        super().__init__(lr, grad_clip)
+        self.b1, self.b2, self.eps = b1, b2, eps
+
+    weight_decay = 0.0  # AdamW overrides; keeps `_update` shared
 
     def init(self, params: Any) -> Any:
         return {"m": tree_map(jnp.zeros_like, params),
@@ -62,6 +180,8 @@ class Adam:
                 "t": jnp.zeros((), jnp.int32)}
 
     def step(self, params: Any, grads: Any, state: Any):
+        grads = self._prep(grads)
+        lr = self._lr_at(state["t"])  # schedule indexed 0-based
         t = state["t"] + 1
         m = tree_map(lambda m_, g: self.b1 * m_ + (1 - self.b1) * g,
                      state["m"], grads)
@@ -70,11 +190,26 @@ class Adam:
         tf = t.astype(jnp.float32)
         bc1 = 1 - self.b1 ** tf
         bc2 = 1 - self.b2 ** tf
+        wd = self.weight_decay
         new = tree_map(
-            lambda p, m_, v_: p - self.lr * (m_ / bc1) /
-            (jnp.sqrt(v_ / bc2) + self.eps),
+            lambda p, m_, v_: p - lr * ((m_ / bc1) /
+                                        (jnp.sqrt(v_ / bc2) + self.eps)
+                                        + wd * p),
             params, m, v)
         return new, {"m": m, "v": v, "t": t}
 
 
-OPTIMIZERS = {"sgd": SGD, "momentum": MomentumSGD, "adam": Adam}
+class AdamW(Adam):
+    """Adam with decoupled weight decay (Loshchilov & Hutter, 2019):
+    the decay term `wd * p` joins the update *after* the moment estimate,
+    scaled by lr — torch.optim.AdamW semantics."""
+
+    def __init__(self, lr: LR, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8, weight_decay: float = 0.01,
+                 grad_clip: float | None = None):
+        super().__init__(lr, b1, b2, eps, grad_clip)
+        self.weight_decay = weight_decay
+
+
+OPTIMIZERS = {"sgd": SGD, "momentum": MomentumSGD, "adam": Adam,
+              "adamw": AdamW}

@@ -35,6 +35,7 @@ fused sequential engine in tests/test_spmd_pipeline.py.
 
 from __future__ import annotations
 
+import copy
 from functools import partial
 
 import jax
@@ -162,7 +163,12 @@ class SPMDPipelineEngine:
         L = self.stack.L
         pp = self.pp
         gbs = self.gbs
-        opt = self.optimizer
+        # opt.step is traced inside shard_map with grads SHARDED over 'pp'
+        # (each device holds only its stage's slice), so the clipping norm
+        # must psum over 'pp' to be global. Private copy: the caller's
+        # optimizer may also drive engines with full-gradient contexts.
+        opt = copy.copy(self.optimizer)
+        opt.clip_axes = ("pp",)
         valid_full, relu_full = self._valid_full, self._relu_full
         head_mask = self._head_mask
 

@@ -129,6 +129,11 @@ class StageRuntime:
             return dx, total
 
         def _opt(params, grads, opt_state):
+            # Per-stage update outside shard_map: `grad_clip` here clips by
+            # the *stage's* gradient norm (stages are independent programs
+            # in this interpreted engine). The compiled SPMD engine
+            # (`spmd_pipeline.py`) clips by the true cross-stage global
+            # norm via clip_axes=("pp",).
             return rt.optimizer.step(params, grads, opt_state)
 
         self._fwd = _fwd

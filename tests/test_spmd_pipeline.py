@@ -156,3 +156,15 @@ def test_spmd_with_adam(data_dir):
     fused = train_fused(data_dir, opt=Adam(0.05))
     spmd = train_spmd(data_dir, 2, 2, opt=Adam(0.05))
     assert_matches_fused(spmd, fused, rtol=5e-2, atol=5e-3)
+
+
+def test_spmd_grad_clip_uses_cross_stage_norm(data_dir):
+    """Global-norm clipping must psum the squared norm over 'pp'
+    (`optim.py clip_axes`): each device holds only its stage's gradient
+    slice inside the shard_map step. A tight threshold makes clipping
+    active every step, so a per-shard (wrong) norm would scale each
+    stage's update differently and diverge from the serial run."""
+    opt = lambda: SGD(LR, grad_clip=0.05)  # noqa: E731
+    fused = train_fused(data_dir, opt=opt())
+    spmd = train_spmd(data_dir, 2, 4, opt=opt())
+    assert_matches_fused(spmd, fused, rtol=1e-3, atol=1e-5)

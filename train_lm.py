@@ -44,7 +44,16 @@ def parse_args(argv=None):
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--optimizer", default="adam",
-                   choices=["sgd", "momentum", "adam"])
+                   choices=["sgd", "momentum", "adam", "adamw"])
+    p.add_argument("--weight-decay", type=float, default=0.01,
+                   help="decoupled weight decay (adamw only)")
+    p.add_argument("--grad-clip", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--lr-schedule", default="constant",
+                   choices=["constant", "linear", "cosine"],
+                   help="lr schedule; linear/cosine warm up over "
+                        "--warmup-steps then decay to 0 at --steps")
+    p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard optimizer state over the dp axis "
                         "(1/dp per-device Adam moment footprint; GSPMD "
@@ -129,7 +138,17 @@ def train(args) -> float:
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             max_seq=args.seq_len, n_experts=args.experts,
                             moe_top_k=args.moe_top_k)
-    opt = OPTIMIZERS[args.optimizer](lr=args.lr)
+    from shallowspeed_tpu.optim import SCHEDULES
+
+    if args.lr_schedule == "constant":
+        lr = args.lr  # static float keeps SGD stateless (no step counter)
+    else:
+        lr = SCHEDULES[args.lr_schedule](
+            peak=args.lr, warmup=args.warmup_steps, total=args.steps)
+    opt_kw = {"grad_clip": args.grad_clip or None}
+    if args.optimizer == "adamw":
+        opt_kw["weight_decay"] = args.weight_decay
+    opt = OPTIMIZERS[args.optimizer](lr=lr, **opt_kw)
     devs = np.array(jax.devices()[: args.dp * model_par])
     if args.ep > 1 or args.experts:
         from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
