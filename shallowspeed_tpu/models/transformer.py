@@ -39,6 +39,15 @@ class TransformerConfig:
     n_layers: int = 2
     max_seq: int = 1024
     dtype: np.dtype = np.float32
+    # Mixed precision: params stay in `dtype` (float32 master weights, and
+    # the optimizer state with them); the forward pass casts them — and all
+    # activations — to `compute_dtype` so matmuls run as bf16 MXU passes.
+    # Stability-critical reductions stay float32 no matter what: layernorm
+    # statistics, attention scores/softmax (`ops/attention.py`), the MoE
+    # router (`ops/moe.py`), and the final log-softmax in `loss`. Gradients
+    # come out float32 (the transpose of the param cast converts back).
+    # None = compute in the param dtype (pure float32 training).
+    compute_dtype: object = None
     # Mixture-of-experts (0 = dense FFN everywhere). With n_experts > 0 every
     # block's FFN becomes a top-k routed MoE (`ops/moe.py`) — the family the
     # reference lacks entirely (SURVEY §2: EP absent).
@@ -95,9 +104,14 @@ def init(cfg: TransformerConfig, seed: int = 0):
 
 
 def _layernorm(p, x, eps=1e-5):
-    mu = x.mean(axis=-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * p["g"] + p["b"]
+    """Statistics in float32 (bf16 mean/variance loses too much precision);
+    result back in x's dtype. No-op casts under pure-f32 training."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    y = ((xf - mu) * jax.lax.rsqrt(var + eps) * p["g"].astype(jnp.float32)
+         + p["b"].astype(jnp.float32))
+    return y.astype(x.dtype)
 
 
 def _dense(p, x):
@@ -135,6 +149,10 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     """
     if attn_fn is None:
         attn_fn = partial(attention, causal=True)
+    if cfg.compute_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(cfg.compute_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
     b, t = tokens.shape
     # Under jit an out-of-range gather silently clamps to pos_emb's last row;
     # guard statically where possible (pos_offset is traced in the

@@ -39,25 +39,28 @@ from jax import Array, lax
 _NEG = jnp.float32(-1e30)
 
 
-def _scores(q: Array, k: Array, scale: float) -> Array:
-    """(B, H, Tq, Tk) scaled logits from (B, T, H, D) blocks."""
-    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-
-
 def attention(q: Array, k: Array, v: Array, causal: bool = True) -> Array:
     """Multi-head scaled-dot-product attention.
 
     q, k, v: (batch, seq, heads, head_dim). Returns (batch, seq, heads,
     head_dim). With `causal`, position i attends to positions <= i.
+
+    Mixed-precision safe: scores accumulate in float32 on the MXU
+    (`preferred_element_type`) and the softmax runs in float32 regardless
+    of the input dtype; only the probability @ V matmul runs in the input
+    dtype. With float32 inputs every cast is a no-op.
     """
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    s = _scores(q, k, scale)
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
 
 
 def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,

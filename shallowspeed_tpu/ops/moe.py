@@ -94,7 +94,11 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
     e = p["gate"].shape[1]
     cap = expert_capacity(s, e, top_k, capacity_factor)
 
-    logits = x @ p["gate"]                                     # (G, S, E)
+    # Router in f32 regardless of compute dtype: bf16 gate logits can flip
+    # top-k selections (routing is stability-critical; the softmax in
+    # topk_capacity_routing is f32 already).
+    logits = jnp.einsum("gsd,de->gse", x, p["gate"],
+                        preferred_element_type=jnp.float32)     # (G, S, E)
     combine, dispatch, aux = topk_capacity_routing(logits, cap, top_k)
 
     xin = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)

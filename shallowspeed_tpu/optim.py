@@ -134,7 +134,10 @@ class SGD(_Optimizer):
         sched = callable(self.lr)
         t = state["t"] if sched else jnp.zeros((), jnp.int32)
         lr = self._lr_at(t)
-        new = tree_map(lambda p, g: p - lr * g, params, grads)
+        # update math may promote to f32 (lr is a strong f32 scalar, grads
+        # may be f32 master-dtype); params keep their own dtype
+        new = tree_map(lambda p, g: (p - lr * g).astype(p.dtype),
+                       params, grads)
         return new, ({"t": t + 1} if sched else state)
 
 
@@ -158,8 +161,10 @@ class MomentumSGD(_Optimizer):
         vel0 = state["v"] if sched else state
         t = state["t"] if sched else jnp.zeros((), jnp.int32)
         lr = self._lr_at(t)
-        vel = tree_map(lambda v, g: self.momentum * v + g, vel0, grads)
-        new = tree_map(lambda p, v: p - lr * v, params, vel)
+        vel = tree_map(lambda v, g: (self.momentum * v + g).astype(v.dtype),
+                       vel0, grads)
+        new = tree_map(lambda p, v: (p - lr * v).astype(p.dtype),
+                       params, vel)
         return new, ({"v": vel, "t": t + 1} if sched else vel)
 
 
@@ -183,18 +188,21 @@ class Adam(_Optimizer):
         grads = self._prep(grads)
         lr = self._lr_at(state["t"])  # schedule indexed 0-based
         t = state["t"] + 1
-        m = tree_map(lambda m_, g: self.b1 * m_ + (1 - self.b1) * g,
-                     state["m"], grads)
-        v = tree_map(lambda v_, g: self.b2 * v_ + (1 - self.b2) * g * g,
-                     state["v"], grads)
+        m = tree_map(
+            lambda m_, g: (self.b1 * m_ + (1 - self.b1) * g).astype(m_.dtype),
+            state["m"], grads)
+        v = tree_map(
+            lambda v_, g: (self.b2 * v_
+                           + (1 - self.b2) * g * g).astype(v_.dtype),
+            state["v"], grads)
         tf = t.astype(jnp.float32)
         bc1 = 1 - self.b1 ** tf
         bc2 = 1 - self.b2 ** tf
         wd = self.weight_decay
         new = tree_map(
-            lambda p, m_, v_: p - lr * ((m_ / bc1) /
-                                        (jnp.sqrt(v_ / bc2) + self.eps)
-                                        + wd * p),
+            lambda p, m_, v_: (p - lr * ((m_ / bc1) /
+                                         (jnp.sqrt(v_ / bc2) + self.eps)
+                                         + wd * p)).astype(p.dtype),
             params, m, v)
         return new, {"m": m, "v": v, "t": t}
 

@@ -17,8 +17,6 @@ from shallowspeed_tpu.optim import (
     SCHEDULES, SGD, Adam, AdamW, MomentumSGD, clip_by_global_norm,
     constant, global_norm, warmup_cosine, warmup_linear)
 
-torch = pytest.importorskip("torch")
-
 
 def tree_np(tree):
     return jax.tree_util.tree_map(np.asarray, tree)
@@ -67,6 +65,7 @@ def test_momentum_matches_hand_rolled():
 
 def _torch_run(torch_cls, steps, lr=1e-2, **kw):
     """Run torch optimizer on the same params/grads stream; return final W."""
+    torch = pytest.importorskip("torch")  # oracle only for the parity tests
     p0 = rand_params()
     tw = torch.tensor(np.asarray(p0["W"]), requires_grad=True)
     tb = torch.tensor(np.asarray(p0["b"]), requires_grad=True)
@@ -88,6 +87,7 @@ def _ours_run(opt, steps):
 
 
 def test_adam_matches_torch():
+    torch = pytest.importorskip("torch")
     w, b = _ours_run(Adam(1e-2), steps=5)
     tw, tb = _torch_run(torch.optim.Adam, steps=5, lr=1e-2)
     np.testing.assert_allclose(w, tw, rtol=1e-5, atol=1e-6)
@@ -95,6 +95,7 @@ def test_adam_matches_torch():
 
 
 def test_adamw_matches_torch():
+    torch = pytest.importorskip("torch")
     w, b = _ours_run(AdamW(1e-2, weight_decay=0.1), steps=5)
     tw, tb = _torch_run(torch.optim.AdamW, steps=5, lr=1e-2,
                         weight_decay=0.1)
@@ -200,3 +201,18 @@ def test_scheduled_optimizer_jits():
     p3, state = step(p2, rand_grads(1), state)
     assert np.isfinite(np.asarray(p3["W"])).all()
     assert int(state["t"]) == 2
+
+
+def test_optimizers_preserve_param_dtype():
+    """A strong-f32 lr scalar must not promote non-f32 params/moments: each
+    optimizer casts its update back to the leaf's own dtype."""
+    p = {"w": jnp.ones((4,), jnp.bfloat16)}
+    g = {"w": jnp.ones((4,), jnp.float32)}  # f32 master-dtype grads
+    for opt in (SGD(0.1), MomentumSGD(0.1), Adam(0.1),
+                AdamW(0.1, weight_decay=0.1)):
+        state = opt.init(p)
+        new, state = opt.step(p, g, state)
+        assert new["w"].dtype == jnp.bfloat16, type(opt).__name__
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "dtype") and leaf.dtype != jnp.int32:
+                assert leaf.dtype == jnp.bfloat16, type(opt).__name__

@@ -54,6 +54,9 @@ def parse_args(argv=None):
                    help="lr schedule; linear/cosine warm up over "
                         "--warmup-steps then decay to 0 at --steps")
     p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--bf16", action="store_true",
+                   help="mixed precision: bfloat16 compute (MXU-native), "
+                        "float32 master weights/optimizer state")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard optimizer state over the dp axis "
                         "(1/dp per-device Adam moment footprint; GSPMD "
@@ -134,10 +137,13 @@ def train(args) -> float:
     assert args.seq_len % args.sp == 0
 
     vocab = 256
+    import jax.numpy as jnp
+
     cfg = TransformerConfig(vocab=vocab, d_model=args.d_model,
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             max_seq=args.seq_len, n_experts=args.experts,
-                            moe_top_k=args.moe_top_k)
+                            moe_top_k=args.moe_top_k,
+                            compute_dtype=jnp.bfloat16 if args.bf16 else None)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
