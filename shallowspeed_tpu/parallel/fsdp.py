@@ -1,0 +1,75 @@
+"""FSDP / ZeRO-3: parameters, gradients, AND optimizer state sharded
+over the data-parallel axis.
+
+ZeRO stage 3 (Rajbhandari et al., 2020) / torch FSDP eliminate all
+replicated training state: every rank owns 1/dp of each parameter, gathers
+full parameters just-in-time for each layer's compute, re-gathers for the
+backward, and reduce-scatters gradients so each rank keeps only its
+gradient shard for the (sharded) optimizer update. The reference framework
+replicates everything (SURVEY §2: its DP is gradient-all-reduce only,
+`/root/reference/shallowspeed/pipe.py:302-327`).
+
+TPU-native formulation: FSDP is a *placement decision*, not a runtime.
+Each parameter leaf gets `PartitionSpec('dp' on its largest divisible
+dim)`; the batch is sharded over 'dp' as usual; the training step is the
+same jitted `(params, opt_state, batch) -> (params, opt_state, loss)`
+program as every other GSPMD engine. XLA's SPMD partitioner then inserts
+exactly the collective schedule ZeRO-3 hand-codes — all-gather each
+weight where the forward/backward needs it full, reduce-scatter each
+gradient where the update needs it sharded — and its latency-hiding
+scheduler overlaps those collectives with compute. Optimizer moments
+inherit the parameter sharding via `zeros_like` (see `GSPMDEngine`), so
+the per-device footprint of params + grads + moments is 1/dp with no
+extra machinery: ZeRO-1 and ZeRO-2 fall out as strict subsets.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.parallel.gspmd import GSPMDEngine
+
+tree_map = jax.tree_util.tree_map
+
+
+def fsdp_spec(shape: tuple, dp: int) -> P:
+    """Shard the LARGEST dp-divisible dimension over 'dp' (the biggest
+    shard-able axis minimizes the number of leaves that stay replicated
+    and spreads the big matrices); replicate leaves with no divisible dim
+    (e.g. tiny biases when dp > their length)."""
+    candidates = [(d, i) for i, d in enumerate(shape) if d and d % dp == 0]
+    if not candidates:
+        return P()
+    _, i = max(candidates)
+    entries = [None] * len(shape)
+    entries[i] = "dp"
+    return P(*entries)
+
+
+class FSDPEngine(GSPMDEngine):
+    """Fully-sharded data-parallel trainer for the transformer family.
+
+    Mesh: 1-D `('dp',)` — FSDP is pure data parallelism with sharded
+    state. Composes with `compute_dtype=bfloat16` (mixed precision) like
+    every transformer engine; `zero1` is meaningless here (the optimizer
+    state is already fully sharded) and rejected.
+    """
+
+    def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
+                 seed: int = 0, zero1: bool = False):
+        if zero1:
+            raise ValueError(
+                "FSDP already shards the optimizer state (ZeRO-3 is a "
+                "superset of ZeRO-1); drop zero1=True")
+        super().__init__(cfg, optimizer, mesh, seed=seed, zero1=False)
+
+    def validate(self, cfg: T.TransformerConfig, mesh: Mesh) -> None:
+        assert mesh.axis_names == ("dp",), (
+            f"FSDPEngine expects a 1-D ('dp',) mesh, got {mesh.axis_names}")
+
+    def param_specs(self, cfg: T.TransformerConfig) -> dict:
+        dp = self.mesh.devices.shape[0]
+        # shapes from the host init the base class already built
+        return tree_map(lambda a: fsdp_spec(a.shape, dp), self._params_host)

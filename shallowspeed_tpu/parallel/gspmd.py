@@ -34,13 +34,18 @@ class GSPMDEngine:
         self.validate(cfg, mesh)
         self.dp = mesh.devices.shape[0]
 
+        # one host-side init; exposed to param_specs so shape-dependent
+        # placements (FSDP) don't re-run it
+        params_host = T.init(cfg, seed)
+        self._params_host = params_host
         self.shardings = tree_map(
             lambda s: NamedSharding(mesh, s), self.param_specs(cfg),
             is_leaf=lambda x: isinstance(x, P))
         self.rep = NamedSharding(mesh, P())
         self.batch = NamedSharding(mesh, P("dp", None))
 
-        self.params = jax.device_put(T.init(cfg, seed), self.shardings)
+        self.params = jax.device_put(params_host, self.shardings)
+        self._params_host = None  # free the host copy
         # zeros_like preserves sharding, so optimizer moments inherit the
         # parameter placement with no extra spec bookkeeping; leaves created
         # fresh (e.g. Adam's step counter) get pinned replicated.

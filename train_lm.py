@@ -57,6 +57,10 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true",
                    help="mixed precision: bfloat16 compute (MXU-native), "
                         "float32 master weights/optimizer state")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3/FSDP: shard params, grads, AND optimizer "
+                        "state over the dp axis (1-D mesh; XLA derives the "
+                        "just-in-time all-gather / reduce-scatter schedule)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard optimizer state over the dp axis "
                         "(1/dp per-device Adam moment footprint; GSPMD "
@@ -113,6 +117,14 @@ def train(args) -> float:
     if sum(ax > 1 for ax in (args.sp, args.tp, args.ep)) > 1:
         raise SystemExit("--sp/--tp/--ep cannot be combined yet; pick one "
                          "model-parallel axis (each composes with --dp)")
+    if args.fsdp and (args.sp > 1 or args.tp > 1 or args.ep > 1
+                      or args.experts or args.zero1):
+        raise SystemExit("--fsdp is pure sharded data parallelism: it "
+                         "composes with --dp only (and already subsumes "
+                         "--zero1)")
+    if args.fsdp and args.attn != "ring":
+        raise SystemExit(f"--attn {args.attn} is not available with --fsdp "
+                         "(the GSPMD engine uses XLA attention)")
     if args.tp > 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with --tp "
                          "(the GSPMD engine uses XLA attention)")
@@ -156,7 +168,12 @@ def train(args) -> float:
         opt_kw["weight_decay"] = args.weight_decay
     opt = OPTIMIZERS[args.optimizer](lr=lr, **opt_kw)
     devs = np.array(jax.devices()[: args.dp * model_par])
-    if args.ep > 1 or args.experts:
+    if args.fsdp:
+        from shallowspeed_tpu.parallel.fsdp import FSDPEngine
+
+        mesh = Mesh(devs.reshape(args.dp), ("dp",))
+        engine = FSDPEngine(cfg, opt, mesh, seed=args.seed)
+    elif args.ep > 1 or args.experts:
         from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.ep), ("dp", "ep"))
