@@ -34,18 +34,26 @@ from shallowspeed_tpu.parallel.gspmd import GSPMDEngine
 tree_map = jax.tree_util.tree_map
 
 
-def fsdp_spec(shape: tuple, dp: int) -> P:
-    """Shard the LARGEST dp-divisible dimension over 'dp' (the biggest
-    shard-able axis minimizes the number of leaves that stay replicated
-    and spreads the big matrices); replicate leaves with no divisible dim
-    (e.g. tiny biases when dp > their length)."""
-    candidates = [(d, i) for i, d in enumerate(shape) if d and d % dp == 0]
+def add_dp(spec: P, shape: tuple, dp: int) -> P:
+    """Add 'dp' to the LARGEST dimension not already sharded and divisible
+    by dp (the biggest shard-able axis minimizes the number of leaves that
+    stay replicated and spreads the big matrices); return the spec
+    unchanged if none qualifies (e.g. tiny biases when dp > their length).
+    The single placement rule behind both pure FSDP (empty base spec) and
+    ZeRO-3-over-TP (`parallel/composite.py`)."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = [(d, i) for i, d in enumerate(shape)
+                  if entries[i] is None and d and d % dp == 0]
     if not candidates:
-        return P()
+        return spec
     _, i = max(candidates)
-    entries = [None] * len(shape)
     entries[i] = "dp"
     return P(*entries)
+
+
+def fsdp_spec(shape: tuple, dp: int) -> P:
+    """Pure-FSDP placement: `add_dp` from a fully replicated base."""
+    return add_dp(P(), shape, dp)
 
 
 class FSDPEngine(GSPMDEngine):

@@ -42,7 +42,7 @@ class GSPMDEngine:
             lambda s: NamedSharding(mesh, s), self.param_specs(cfg),
             is_leaf=lambda x: isinstance(x, P))
         self.rep = NamedSharding(mesh, P())
-        self.batch = NamedSharding(mesh, P("dp", None))
+        self.batch = NamedSharding(mesh, self.batch_spec())
 
         self.params = jax.device_put(params_host, self.shardings)
         self._params_host = None  # free the host copy
@@ -91,6 +91,11 @@ class GSPMDEngine:
 
     def param_specs(self, cfg: T.TransformerConfig) -> dict:
         raise NotImplementedError
+
+    def batch_spec(self) -> P:
+        """(batch, seq) token sharding; subclasses with a sequence axis
+        override (e.g. P('dp', 'sp') in the composite 3-D engine)."""
+        return P("dp", None)
 
     # ------------------------------------------------------- training
 

@@ -29,10 +29,11 @@ def parse_args(argv=None):
                    help="sequence/context-parallel degree (ring attention)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree (Megatron placement via "
-                        "GSPMD); exclusive with --sp for now")
+                        "GSPMD); with --sp > 1 both run on one 3-D "
+                        "(dp, sp, tp) mesh")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel degree (requires --experts > 0); "
-                        "exclusive with --sp/--tp for now")
+                        "composes with --dp only")
     p.add_argument("--experts", type=int, default=0,
                    help="number of MoE experts per block (0 = dense FFN)")
     p.add_argument("--moe-top-k", type=int, default=2)
@@ -59,8 +60,10 @@ def parse_args(argv=None):
                         "float32 master weights/optimizer state")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3/FSDP: shard params, grads, AND optimizer "
-                        "state over the dp axis (1-D mesh; XLA derives the "
-                        "just-in-time all-gather / reduce-scatter schedule)")
+                        "state over the dp axis (XLA derives the "
+                        "just-in-time all-gather / reduce-scatter "
+                        "schedule); stacks onto --sp/--tp via the 3-D "
+                        "composite engine")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard optimizer state over the dp axis "
                         "(1/dp per-device Adam moment footprint; GSPMD "
@@ -69,7 +72,9 @@ def parse_args(argv=None):
                    choices=["ring", "ulysses", "flash"],
                    help="attention substrate: ring (any --sp), ulysses "
                         "(all-to-all; needs n_heads %% sp == 0) or the "
-                        "fused Pallas flash kernel (--sp 1 only)")
+                        "fused Pallas flash kernel (--sp 1 only); with "
+                        "--tp/--fsdp the GSPMD engines use XLA attention "
+                        "(K/V all-gather under --sp)")
     p.add_argument("--text", type=str, default="",
                    help="train on this UTF-8 text file (byte-level vocab)")
     p.add_argument("--seed", type=int, default=0)
@@ -114,20 +119,19 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
 
-    if sum(ax > 1 for ax in (args.sp, args.tp, args.ep)) > 1:
-        raise SystemExit("--sp/--tp/--ep cannot be combined yet; pick one "
-                         "model-parallel axis (each composes with --dp)")
-    if args.fsdp and (args.sp > 1 or args.tp > 1 or args.ep > 1
-                      or args.experts or args.zero1):
-        raise SystemExit("--fsdp is pure sharded data parallelism: it "
-                         "composes with --dp only (and already subsumes "
-                         "--zero1)")
-    if args.fsdp and args.attn != "ring":
-        raise SystemExit(f"--attn {args.attn} is not available with --fsdp "
-                         "(the GSPMD engine uses XLA attention)")
-    if args.tp > 1 and args.attn != "ring":
-        raise SystemExit(f"--attn {args.attn} is not available with --tp "
-                         "(the GSPMD engine uses XLA attention)")
+    composite = args.sp > 1 and args.tp > 1
+    if args.ep > 1 and (args.sp > 1 or args.tp > 1):
+        raise SystemExit("--ep composes with --dp only (not --sp/--tp)")
+    if args.fsdp and (args.ep > 1 or args.experts or args.zero1):
+        raise SystemExit("--fsdp composes with --dp/--sp/--tp (and already "
+                         "subsumes --zero1; MoE uses --ep)")
+    if args.fsdp and (args.sp > 1 or args.tp > 1):
+        composite = True  # ZeRO-3 on top of the 3-D mesh
+    if (args.fsdp or args.tp > 1) and args.attn != "ring":
+        raise SystemExit(f"--attn {args.attn} is not available with "
+                         "--tp/--fsdp (the GSPMD engines use XLA attention; "
+                         "under --sp the composite engine's context "
+                         "parallelism is the K/V all-gather formulation)")
     if args.ep > 1 and args.experts == 0:
         raise SystemExit("--ep requires --experts > 0")
     if args.experts and (args.sp > 1 or args.tp > 1):
@@ -139,7 +143,8 @@ def train(args) -> float:
     if args.experts and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with "
                          "--experts (the MoE engine uses XLA attention)")
-    model_par = max(args.tp, args.sp, args.ep)
+    model_par = args.sp * args.tp if composite else max(args.tp, args.sp,
+                                                        args.ep)
     n_dev = len(jax.devices())
     if args.dp * model_par > n_dev:
         raise SystemExit(f"requested dp*model_parallel="
@@ -168,7 +173,14 @@ def train(args) -> float:
         opt_kw["weight_decay"] = args.weight_decay
     opt = OPTIMIZERS[args.optimizer](lr=lr, **opt_kw)
     devs = np.array(jax.devices()[: args.dp * model_par])
-    if args.fsdp:
+    if composite:
+        from shallowspeed_tpu.parallel.composite import Composite3DEngine
+
+        mesh = Mesh(devs.reshape(args.dp, args.sp, args.tp),
+                    ("dp", "sp", "tp"))
+        engine = Composite3DEngine(cfg, opt, mesh, seed=args.seed,
+                                   zero1=args.zero1, fsdp=args.fsdp)
+    elif args.fsdp:
         from shallowspeed_tpu.parallel.fsdp import FSDPEngine
 
         mesh = Mesh(devs.reshape(args.dp), ("dp",))
