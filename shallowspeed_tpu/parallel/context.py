@@ -157,12 +157,17 @@ class ContextParallelEngine:
     # -------------------------------------------------------------- data
 
     def _place(self, arr: np.ndarray):
+        # multi-host: arr is this process's local rows (place_global
+        # stitches the global array); single-process: the global batch
+        from shallowspeed_tpu.distributed import place_global
+
         b, t = arr.shape[:2]
-        assert b % self.dp == 0, (b, self.dp)
+        # local rows x processes = global batch; it must divide over dp
+        assert (b * jax.process_count()) % self.dp == 0, (b, self.dp)
         assert t % self.sp == 0, (t, self.sp)
         assert t <= self.cfg.max_seq, (
             f"global sequence length {t} exceeds max_seq={self.cfg.max_seq}")
-        return jax.device_put(arr, self.tile)
+        return place_global(arr, self.tile)
 
     # -------------------------------------------------------------- steps
 

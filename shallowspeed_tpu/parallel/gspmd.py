@@ -106,9 +106,16 @@ class GSPMDEngine:
         return jax.device_put(leaf, self.rep)
 
     def _place(self, arr: np.ndarray):
-        assert arr.shape[0] % self.dp == 0, (arr.shape, self.dp)
+        # multi-host: arr is this process's local rows; single-process:
+        # the global batch (place_global handles both)
+        from shallowspeed_tpu.distributed import place_global
+
+        # local rows x processes = global batch; it must divide over dp
+        # (single-process: arr IS the global batch — the original invariant)
+        assert (arr.shape[0] * jax.process_count()) % self.dp == 0, (
+            arr.shape, self.dp)
         assert arr.shape[1] <= self.cfg.max_seq
-        return jax.device_put(arr, self.batch)
+        return place_global(arr, self.batch)
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         if self._step_fn is None:  # ZeRO-1: grad program + sharded update

@@ -1,0 +1,89 @@
+"""Multi-host runtime helpers (`shallowspeed_tpu/distributed.py`).
+
+True multi-process runs need multiple hosts; what a single process CAN
+verify is the contract every helper promises for the single-process case
+(exact no-op / plain-JAX behavior) plus the mesh-construction logic, which
+is pure topology arithmetic.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shallowspeed_tpu import distributed as D
+
+
+def test_initialize_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    assert D.initialize() is False
+    assert jax.process_count() == 1  # still single-process
+
+
+def test_process_zero_single_process():
+    assert D.process_zero() is True
+
+
+def test_barrier_noop_single_process():
+    D.barrier("test")  # must not raise or block
+
+
+def test_hybrid_mesh_single_slice_fallback():
+    mesh = D.hybrid_mesh(("dp", "sp", "tp"), (2, 2, 2))
+    assert mesh.axis_names == ("dp", "sp", "tp")
+    assert mesh.devices.shape == (2, 2, 2)
+    # row-major: same layout the engines' plain reshape would produce
+    assert (mesh.devices.ravel().tolist()
+            == list(jax.devices()[:8]))
+
+
+def test_hybrid_mesh_rejects_oversubscription():
+    with pytest.raises(AssertionError, match="needs 16 devices"):
+        D.hybrid_mesh(("dp", "tp"), (8, 2))
+
+
+def test_place_global_single_process_is_device_put():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    arr = np.arange(32, dtype=np.float32).reshape(4, 8)
+    out = D.place_global(arr, sh)
+    assert out.sharding == sh
+    np.testing.assert_array_equal(np.asarray(out), arr)
+
+
+def test_engines_train_through_place_global():
+    """The GSPMD/context engines route batches through place_global; a
+    single-process run must behave exactly as before."""
+    from shallowspeed_tpu.models.transformer import TransformerConfig
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.context import ContextParallelEngine
+
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=1,
+                            max_seq=16)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    eng = ContextParallelEngine(cfg, SGD(0.05), mesh, seed=0)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 32, (4, 16)).astype(np.int32)
+    loss = eng.train_batch(tok, np.roll(tok, -1, axis=1))
+    assert np.isfinite(loss)
+
+
+def test_local_rows_single_process_noop():
+    arr = np.arange(12).reshape(4, 3)
+    assert D.local_rows(arr) is arr
+
+
+def test_local_rows_multiprocess_slicing(monkeypatch):
+    """Simulate P=4 processes: each must get its contiguous row-block."""
+    arr = np.arange(8 * 2).reshape(8, 2)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    for pid in range(4):
+        monkeypatch.setattr(jax, "process_index", lambda p=pid: p)
+        out = D.local_rows(arr)
+        np.testing.assert_array_equal(out, arr[pid * 2:(pid + 1) * 2])
+    # indivisible batch rejected with a labeled error
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    with pytest.raises(AssertionError, match="divide over 3"):
+        D.local_rows(arr)

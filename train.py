@@ -83,6 +83,11 @@ def configure_platform(args):
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    # multi-host: connect to the JAX distributed service when a coordinator
+    # is configured (env vars / TPU pod metadata); single-process no-op
+    from shallowspeed_tpu import distributed
+
+    distributed.initialize()
 
 
 def build(args):

@@ -227,9 +227,13 @@ def train(args) -> float:
         assert len(text_data) > args.seq_len + 1, "text too short for --seq-len"
     t0 = time.time()
     loss = float("nan")
+    from shallowspeed_tpu.distributed import local_rows
+
     for step in range(start_step, args.steps):
         tokens, targets = make_batch(args, vocab, step, text_data)
-        loss = engine.train_batch(tokens, targets)
+        # multi-host: every process builds the same seeded global batch and
+        # feeds its own row-block (no-op single-process)
+        loss = engine.train_batch(local_rows(tokens), local_rows(targets))
         if step % args.log_every == 0 or step == args.steps - 1:
             toks_s = (args.batch_size * args.seq_len * (step - start_step + 1)
                       / (time.time() - t0))
