@@ -1,0 +1,92 @@
+"""Async host->device input pipeline.
+
+The reference's data path is synchronous: every microbatch load is a
+blocking slice copy on the critical path
+(`/root/reference/shallowspeed/dataset.py:66-80`, called per-instruction
+from the Worker, `pipe.py:355-365`). On TPU the equivalent stall is worse:
+if the host only starts building + transferring batch N+1 after batch N's
+step returns, the chip idles for the whole host time every step.
+
+`DevicePrefetcher` overlaps the three stages the TPU way:
+
+- a daemon thread pulls from the (host-side) batch iterator and immediately
+  *places* each batch — `device_put`/`place_global` are async in JAX, so
+  the H2D DMA streams while the device computes;
+- a bounded queue keeps up to `depth` placed batches in flight (depth 2 =
+  classic double buffering: one computing, one transferring);
+- together with the engines' `train_batch_async` (loss returned as a lazy
+  device value instead of a blocking `float()`), the dispatch loop never
+  waits on the host: XLA's async dispatch queues step N+1 while N runs.
+
+Producer exceptions are captured and re-raised at the consuming end, so
+error behavior matches the synchronous loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+_DONE = object()
+
+
+class DevicePrefetcher:
+    """Iterate `it`, applying `place` to each item `depth` items ahead.
+
+    `place` maps one host batch (any pytree of numpy arrays) to its placed
+    form; it runs on the producer thread. Iteration order is preserved.
+    """
+
+    def __init__(self, it: Iterable[Any], place: Callable[[Any], Any],
+                 depth: int = 2):
+        assert depth >= 1
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._err: BaseException | None = None
+        self._done = False
+
+        def produce():
+            try:
+                for item in it:
+                    self._q.put(place(item))
+            except BaseException as e:  # re-raised on the consumer side
+                self._err = e
+            finally:
+                self._q.put(_DONE)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:  # exhausted (or errored): stay terminated, never
+            raise StopIteration  # block on a queue no producer feeds
+        item = self._q.get()
+        if item is _DONE:
+            self._done = True
+            self._thread.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+def prefetch_to_device(it: Iterable[Any], place: Callable[[Any], Any],
+                       depth: int = 2) -> Iterator[Any]:
+    """Functional spelling of `DevicePrefetcher` (depth<=0 disables —
+    returns the plain mapped iterator, same semantics, no thread)."""
+    if depth <= 0:
+        return (place(item) for item in it)
+    return DevicePrefetcher(it, place, depth)
+
+
+def sync_every(step: int, every: int, total: int) -> bool:
+    """Whether the driver should force a host sync at `step` (log points
+    and the final step). Keeping float(loss) off the other steps is what
+    lets dispatch run ahead."""
+    return step % every == 0 or step == total - 1
+
+
+__all__ = ["DevicePrefetcher", "prefetch_to_device", "sync_every"]

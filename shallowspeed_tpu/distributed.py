@@ -151,7 +151,8 @@ def place_global(arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
     reference's per-rank `Dataset.load(DP_rank, DP_size)` strided shards
     (`dataset.py:54-58`) map to single-controller-per-host JAX.
     """
-    if jax.process_count() == 1:
+    if isinstance(arr, jax.Array) or jax.process_count() == 1:
+        # already placed (no-op/reshard) or single-process global array
         return jax.device_put(arr, sharding)
     return jax.make_array_from_process_local_data(sharding, arr)
 

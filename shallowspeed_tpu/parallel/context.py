@@ -171,18 +171,27 @@ class ContextParallelEngine:
 
     # -------------------------------------------------------------- steps
 
-    def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
-        """One optimizer step on a (B, T) int token batch; returns the loss."""
+    def place(self, arr) -> jax.Array:
+        """Public placement hook for prefetch pipelines."""
+        return self._place(arr)
+
+    def train_batch_async(self, tokens, targets) -> jax.Array:
+        """One optimizer step; loss as a lazy device scalar (no host sync —
+        `float()` it only at log points; see `data/prefetch.py`)."""
         if self._step_fn is None:  # ZeRO-1: grad program + sharded update
             loss, grads = self._loss_grads_fn(
                 self.params, self._place(tokens), self._place(targets))
             self.params, self.opt_state = self._update_fn(
                 self.params, grads, self.opt_state)
-            return float(loss)
+            return loss
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state,
             self._place(tokens), self._place(targets))
-        return float(loss)
+        return loss
+
+    def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        """One optimizer step on a (B, T) int token batch; returns the loss."""
+        return float(self.train_batch_async(tokens, targets))
 
     def eval_loss(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         return float(self._eval_fn(

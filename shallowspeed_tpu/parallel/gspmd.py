@@ -117,17 +117,29 @@ class GSPMDEngine:
         assert arr.shape[1] <= self.cfg.max_seq
         return place_global(arr, self.batch)
 
-    def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+    def place(self, arr) -> jax.Array:
+        """Public placement hook (prefetch pipelines place batches ahead of
+        the step; already-placed jax.Arrays pass through device_put as
+        no-ops)."""
+        return self._place(arr)
+
+    def train_batch_async(self, tokens, targets) -> jax.Array:
+        """One optimizer step; the loss returns as a LAZY device scalar so
+        the dispatch loop never blocks on it (callers `float()` only when
+        they actually log — see `data/prefetch.py`)."""
         if self._step_fn is None:  # ZeRO-1: grad program + sharded update
             loss, grads = self._grads_fn(
                 self.params, self._place(tokens), self._place(targets))
             self.params, self.opt_state = self._update_fn(
                 self.params, grads, self.opt_state)
-            return float(loss)
+            return loss
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state,
             self._place(tokens), self._place(targets))
-        return float(loss)
+        return loss
+
+    def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        return float(self.train_batch_async(tokens, targets))
 
     def eval_loss(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         return float(self._eval_fn(

@@ -79,6 +79,10 @@ def parse_args(argv=None):
                    help="train on this UTF-8 text file (byte-level vocab)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="input-pipeline depth: batches built + placed on "
+                        "device this many steps ahead on a background "
+                        "thread (0 = synchronous)")
     p.add_argument("--save-every", type=int, default=100,
                    help="checkpoint every N steps when --save-dir is set")
     p.add_argument("--save-dir", type=str, default="")
@@ -227,14 +231,27 @@ def train(args) -> float:
         assert len(text_data) > args.seq_len + 1, "text too short for --seq-len"
     t0 = time.time()
     loss = float("nan")
+    from shallowspeed_tpu.data.prefetch import prefetch_to_device, sync_every
     from shallowspeed_tpu.distributed import local_rows
 
-    for step in range(start_step, args.steps):
-        tokens, targets = make_batch(args, vocab, step, text_data)
-        # multi-host: every process builds the same seeded global batch and
-        # feeds its own row-block (no-op single-process)
-        loss = engine.train_batch(local_rows(tokens), local_rows(targets))
-        if step % args.log_every == 0 or step == args.steps - 1:
+    def batches():
+        for step in range(start_step, args.steps):
+            tok, tgt = make_batch(args, vocab, step, text_data)
+            # multi-host: every process builds the same seeded global batch
+            # and feeds its own row-block (no-op single-process)
+            yield local_rows(tok), local_rows(tgt)
+
+    # batches are built + placed `--prefetch` steps ahead on a background
+    # thread (H2D streams under the running step), and the loss stays a
+    # lazy device scalar except at log points — the dispatch loop never
+    # blocks on the host
+    placed = prefetch_to_device(
+        batches(), lambda b: (engine.place(b[0]), engine.place(b[1])),
+        depth=args.prefetch)
+    for step, (tok, tgt) in zip(range(start_step, args.steps), placed):
+        loss_dev = engine.train_batch_async(tok, tgt)
+        if sync_every(step, args.log_every, args.steps):
+            loss = float(loss_dev)
             toks_s = (args.batch_size * args.seq_len * (step - start_step + 1)
                       / (time.time() - t0))
             rprint(f"step {step:5d}  loss {loss:.4f}  tok/s {toks_s:,.0f}")
