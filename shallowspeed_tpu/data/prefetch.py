@@ -44,18 +44,52 @@ class DevicePrefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._err: BaseException | None = None
         self._done = False
+        self._stop = threading.Event()
+
+        def put(item) -> bool:
+            """Bounded put that gives up when close() signals; returns
+            False to end the producer."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for item in it:
-                    self._q.put(place(item))
+                    if self._stop.is_set() or not put(place(item)):
+                        return
             except BaseException as e:  # re-raised on the consumer side
                 self._err = e
             finally:
-                self._q.put(_DONE)
+                put(_DONE)
 
         self._thread = threading.Thread(target=produce, daemon=True)
         self._thread.start()
+
+    def close(self) -> None:
+        """Stop the producer and release queued (device) batches. Safe to
+        call any time; consumers abandoning iteration early (errors,
+        breaks) should close() — e.g. in a `finally:` — so up-to-`depth`
+        placed batches don't stay pinned in device memory."""
+        self._stop.set()
+        self._done = True
+        while True:  # drain so the producer's pending put unblocks
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def __iter__(self) -> Iterator[Any]:
         return self

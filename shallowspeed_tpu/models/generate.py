@@ -1,0 +1,164 @@
+"""Autoregressive decoding with a KV cache for the transformer LM family.
+
+The reference's only inference surface is a forward-only pipeline schedule
+over the MLP (`/root/reference/shallowspeed/pipe.py:275-294`); sequence
+models need real decoding. Designed TPU-first:
+
+- **Static shapes.** The KV cache is a fixed (B, max_seq, H, hd) buffer
+  per block; the decode loop is one `lax.scan` over `max_new` steps —
+  the whole generation compiles to a single XLA program, no per-token
+  Python dispatch or retracing.
+- **Parallel prefill.** The prompt runs through the normal batched
+  forward (`_block(..., with_kv=True)` captures each block's K/V in one
+  MXU-friendly pass); only the new tokens decode sequentially.
+- **f32 score path.** Decode attention accumulates scores in f32 with a
+  position mask over the not-yet-written cache tail, matching
+  `ops/attention.py` numerics, so cached decoding reproduces the batched
+  forward's logits exactly (tested to 1e-4).
+
+Sampling: temperature (0 = greedy argmax) and optional top-k truncation,
+with `jax.random` counter-based keys — reproducible given a seed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from shallowspeed_tpu.models import transformer as T
+
+
+def init_kv_cache(cfg: T.TransformerConfig, batch: int):
+    """Per-block K/V buffers (B, max_seq, H, head_dim), zero-filled."""
+    dt = cfg.compute_dtype or cfg.dtype
+    shape = (batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            for _ in range(cfg.n_layers)]
+
+
+def _cached_attention(q, cache_blk, pos):
+    """q: (B, 1, H, hd) at position `pos`; attends over cache[:, :pos+1].
+
+    The cache tail beyond `pos` is zeros — masked out by position, so its
+    contents never matter.
+    """
+    k, v = cache_blk["k"], cache_blk["v"]
+    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(k.shape[1]) <= pos                  # (max_seq,)
+    s = jnp.where(valid[None, None, None, :], s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
+    """One block on a single-token slice x (B, 1, d); writes this token's
+    K/V at `pos` and attends over the cache. Returns (x, cache_blk)."""
+    b = x.shape[0]
+    h = T._layernorm(p["ln1"], x)
+    qkv = T._dense(p["qkv"], h).reshape(b, 1, cfg.n_heads, 3, cfg.head_dim)
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    cache_blk = {
+        "k": jax.lax.dynamic_update_slice_in_dim(
+            cache_blk["k"], k.astype(cache_blk["k"].dtype), pos, axis=1),
+        "v": jax.lax.dynamic_update_slice_in_dim(
+            cache_blk["v"], v.astype(cache_blk["v"].dtype), pos, axis=1),
+    }
+    a = _cached_attention(q, cache_blk, pos).reshape(b, 1, cfg.d_model)
+    x = x + T._dense(p["proj"], a)
+    h = T._layernorm(p["ln2"], x)
+    x, _aux = T._ffn(p, x, cfg, h)
+    return x, cache_blk
+
+
+def _embed(params, tokens, pos0, cfg):
+    t = tokens.shape[1]
+    pos = pos0 + jnp.arange(t)
+    x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    if cfg.compute_dtype is not None:
+        x = x.astype(cfg.compute_dtype)
+    return x
+
+
+def prefill(params, tokens, cfg: T.TransformerConfig, cache):
+    """Batched forward over the prompt, capturing each block's K/V.
+
+    tokens: (B, Tp). Returns (last-position logits (B, vocab) in f32,
+    filled cache)."""
+    params = T.cast_params(params, cfg.compute_dtype)
+    tp = tokens.shape[1]
+    x = _embed(params, tokens, 0, cfg)
+    attn = partial(T.attention, causal=True)
+    for i, blk in enumerate(params["blocks"]):
+        x, _aux, (k, v) = T._block(blk, x, cfg, attn, with_kv=True)
+        cache[i] = {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["k"], k.astype(cache[i]["k"].dtype), 0, axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache[i]["v"], v.astype(cache[i]["v"].dtype), 0, axis=1),
+        }
+    x = T._layernorm(params["ln_f"], x)
+    logits = T._dense(params["head"], x[:, tp - 1])
+    return logits.astype(jnp.float32), cache
+
+
+def decode_step(params, token, pos, cache, cfg: T.TransformerConfig):
+    """One cached decode step. token: (B,) int32 at position `pos`
+    (traced scalar). Returns (logits (B, vocab) f32, updated cache).
+
+    Callers in a loop should pre-cast params (`T.cast_params`) once; the
+    cast here is then a same-dtype identity."""
+    params = T.cast_params(params, cfg.compute_dtype)
+    x = _embed(params, token[:, None], pos, cfg)
+    new_cache = []
+    for blk, cblk in zip(params["blocks"], cache):
+        x, cblk = _block_decode(blk, x, cfg, cblk, pos)
+        new_cache.append(cblk)
+    x = T._layernorm(params["ln_f"], x)
+    logits = T._dense(params["head"], x[:, 0])
+    return logits.astype(jnp.float32), new_cache
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """logits (B, V) f32 -> token ids (B,). temperature 0 = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]       # (B, 1)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k"))
+def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
+             temperature: float = 1.0, top_k: int = 0, seed=0):
+    """Generate `max_new` tokens after `prompt` (B, Tp). Returns
+    (B, max_new) int32. One compiled program: parallel prefill + a
+    `lax.scan` decode loop over the static step count."""
+    b, tp = prompt.shape
+    assert tp + max_new <= cfg.max_seq, (
+        f"prompt {tp} + max_new {max_new} exceeds max_seq={cfg.max_seq}")
+    params = T.cast_params(params, cfg.compute_dtype)  # once, not per step
+    cache = init_kv_cache(cfg, b)
+    logits, cache = prefill(params, prompt, cfg, cache)
+    rng0 = jax.random.PRNGKey(seed)
+    tok0 = _sample(logits, jax.random.fold_in(rng0, 0), temperature, top_k)
+
+    # sample-after-decode: the final sampled token never triggers another
+    # (discarded) decode pass — exactly max_new - 1 decode steps run
+    def step(carry, i):
+        tok_prev, cache = carry
+        logits, cache = decode_step(params, tok_prev, tp + i, cache, cfg)
+        tok = _sample(logits, jax.random.fold_in(rng0, i + 1),
+                      temperature, top_k)
+        return (tok, cache), tok
+
+    (_, _), toks = jax.lax.scan(step, (tok0, cache),
+                                jnp.arange(max_new - 1))
+    return jnp.concatenate([tok0[None], toks], axis=0).T  # (B, max_new)

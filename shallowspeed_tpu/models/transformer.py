@@ -48,6 +48,11 @@ class TransformerConfig:
     # come out float32 (the transpose of the param cast converts back).
     # None = compute in the param dtype (pure float32 training).
     compute_dtype: object = None
+    # Rematerialization: recompute each block's activations in the backward
+    # instead of storing them (jax.checkpoint around every block). Trades
+    # ~1 extra forward of FLOPs for O(n_layers) -> O(1) activation memory —
+    # the standard long-context lever on HBM-bound TPUs.
+    remat: bool = False
     # Mixture-of-experts (0 = dense FFN everywhere). With n_experts > 0 every
     # block's FFN becomes a top-k routed MoE (`ops/moe.py`) — the family the
     # reference lacks entirely (SURVEY §2: EP absent).
@@ -103,6 +108,17 @@ def init(cfg: TransformerConfig, seed: int = 0):
     }
 
 
+def cast_params(params, compute_dtype):
+    """Mixed-precision boundary: float leaves to `compute_dtype` (None =
+    identity; casting twice is free — same-dtype astype returns the
+    operand). Shared by training forward and the decode path."""
+    if compute_dtype is None:
+        return params
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(compute_dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+
 def _layernorm(p, x, eps=1e-5):
     """Statistics in float32 (bf16 mean/variance loses too much precision);
     result back in x's dtype. No-op casts under pure-f32 training."""
@@ -118,9 +134,22 @@ def _dense(p, x):
     return x @ p["W"] + p["b"]
 
 
-def _block(p, x, cfg: TransformerConfig, attn_fn):
+def _ffn(p, x, cfg: TransformerConfig, h):
+    """Post-attention half of a block: FFN (dense GELU or routed MoE) on
+    the ln2 output `h`, residual onto `x`. Returns (x, aux)."""
+    if "moe" in p:
+        y, aux = moe_ffn(p["moe"], h, cfg.moe_top_k, cfg.moe_capacity_factor)
+        return x + y, aux
+    return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h))), 0.0
+
+
+def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False):
     """One pre-LN block; returns (x, aux) where aux is the MoE
-    load-balancing loss (0.0 for dense blocks)."""
+    load-balancing loss (0.0 for dense blocks). With `with_kv` also
+    returns this block's (k, v) — the decode prefill
+    (`models/generate.py`) captures them into its cache; the training
+    path never requests them, so XLA dead-code-eliminates the extra
+    outputs there."""
     b, t, d = x.shape
     h = _layernorm(p["ln1"], x)
     # head-major fused layout (H, 3, D): a contiguous slice of the 3d output
@@ -132,10 +161,10 @@ def _block(p, x, cfg: TransformerConfig, attn_fn):
     a = attn_fn(q, k, v).reshape(b, t, d)
     x = x + _dense(p["proj"], a)
     h = _layernorm(p["ln2"], x)
-    if "moe" in p:
-        y, aux = moe_ffn(p["moe"], h, cfg.moe_top_k, cfg.moe_capacity_factor)
-        return x + y, aux
-    return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h))), 0.0
+    x, aux = _ffn(p, x, cfg, h)
+    if with_kv:
+        return x, aux, (k, v)
+    return x, aux
 
 
 def forward_with_aux(params, tokens, cfg: TransformerConfig,
@@ -149,10 +178,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     """
     if attn_fn is None:
         attn_fn = partial(attention, causal=True)
-    if cfg.compute_dtype is not None:
-        params = jax.tree_util.tree_map(
-            lambda p: p.astype(cfg.compute_dtype)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+    params = cast_params(params, cfg.compute_dtype)
     b, t = tokens.shape
     # Under jit an out-of-range gather silently clamps to pos_emb's last row;
     # guard statically where possible (pos_offset is traced in the
@@ -164,8 +190,11 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     pos = pos_offset + jnp.arange(t)
     x = params["tok_emb"][tokens] + params["pos_emb"][pos]
     aux_total = 0.0
+    block_fn = _block
+    if cfg.remat:
+        block_fn = jax.checkpoint(_block, static_argnums=(2, 3))
     for blk in params["blocks"]:
-        x, aux = _block(blk, x, cfg, attn_fn)
+        x, aux = block_fn(blk, x, cfg, attn_fn)
         aux_total = aux_total + aux
     x = _layernorm(params["ln_f"], x)
     return _dense(params["head"], x), aux_total

@@ -130,3 +130,21 @@ def test_prefetcher_stays_terminated_after_error():
         next(it)
     with pytest.raises(StopIteration):
         next(it)                   # terminated, not deadlocked
+
+
+def test_close_releases_blocked_producer():
+    """Abandoning iteration early + close() must let the producer thread
+    exit instead of parking forever on a full queue."""
+    it = DevicePrefetcher(range(100), lambda x: x, depth=2)
+    assert next(it) == 0
+    it.close()
+    it._thread.join(timeout=5)
+    assert not it._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)  # closed == terminated
+
+
+def test_context_manager_closes():
+    with DevicePrefetcher(range(50), lambda x: x, depth=2) as it:
+        assert next(it) == 0
+    assert not it._thread.is_alive()

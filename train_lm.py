@@ -58,6 +58,10 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true",
                    help="mixed precision: bfloat16 compute (MXU-native), "
                         "float32 master weights/optimizer state")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize each block's activations in the "
+                        "backward (jax.checkpoint): ~1 extra forward of "
+                        "FLOPs for O(layers)->O(1) activation memory")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3/FSDP: shard params, grads, AND optimizer "
                         "state over the dp axis (XLA derives the "
@@ -77,6 +81,11 @@ def parse_args(argv=None):
                         "(K/V all-gather under --sp)")
     p.add_argument("--text", type=str, default="",
                    help="train on this UTF-8 text file (byte-level vocab)")
+    p.add_argument("--generate", type=int, default=0,
+                   help="after training, sample this many tokens from the "
+                        "model (KV-cache decode) and print them")
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--prefetch", type=int, default=2,
@@ -123,6 +132,9 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
 
+    if args.generate and args.generate + 16 > args.seq_len:
+        raise SystemExit(f"--generate {args.generate} + the 16-token prompt "
+                         f"exceeds --seq-len {args.seq_len} (= max_seq)")
     composite = args.sp > 1 and args.tp > 1
     if args.ep > 1 and (args.sp > 1 or args.tp > 1):
         raise SystemExit("--ep composes with --dp only (not --sp/--tp)")
@@ -164,7 +176,8 @@ def train(args) -> float:
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             max_seq=args.seq_len, n_experts=args.experts,
                             moe_top_k=args.moe_top_k,
-                            compute_dtype=jnp.bfloat16 if args.bf16 else None)
+                            compute_dtype=jnp.bfloat16 if args.bf16 else None,
+                            remat=args.remat)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
@@ -248,18 +261,38 @@ def train(args) -> float:
     placed = prefetch_to_device(
         batches(), lambda b: (engine.place(b[0]), engine.place(b[1])),
         depth=args.prefetch)
-    for step, (tok, tgt) in zip(range(start_step, args.steps), placed):
-        loss_dev = engine.train_batch_async(tok, tgt)
-        if sync_every(step, args.log_every, args.steps):
-            loss = float(loss_dev)
-            toks_s = (args.batch_size * args.seq_len * (step - start_step + 1)
-                      / (time.time() - t0))
-            rprint(f"step {step:5d}  loss {loss:.4f}  tok/s {toks_s:,.0f}")
-            metrics.log(event="step", step=step, loss=round(loss, 6),
-                        tokens_per_sec=round(toks_s, 1))
-        if args.save_dir and ((step + 1) % args.save_every == 0
-                              or step == args.steps - 1):
-            checkpoint.save(args.save_dir, engine, step)
+    try:
+        for step, (tok, tgt) in zip(range(start_step, args.steps), placed):
+            loss_dev = engine.train_batch_async(tok, tgt)
+            if sync_every(step, args.log_every, args.steps):
+                loss = float(loss_dev)
+                toks_s = (args.batch_size * args.seq_len
+                          * (step - start_step + 1) / (time.time() - t0))
+                rprint(f"step {step:5d}  loss {loss:.4f}  "
+                       f"tok/s {toks_s:,.0f}")
+                metrics.log(event="step", step=step, loss=round(loss, 6),
+                            tokens_per_sec=round(toks_s, 1))
+            if args.save_dir and ((step + 1) % args.save_every == 0
+                                  or step == args.steps - 1):
+                checkpoint.save(args.save_dir, engine, step)
+    finally:
+        # abandoning mid-stream must not leave placed batches pinned on
+        # device by a blocked producer thread
+        if hasattr(placed, "close"):
+            placed.close()
+
+    if args.generate > 0:
+        from shallowspeed_tpu.models.generate import generate
+
+        prompt, _ = make_batch(args, vocab, 0, text_data)
+        prompt = prompt[:1, :16]  # one row, short prefix
+        out = np.asarray(generate(
+            engine.get_canonical_params(), prompt, cfg, args.generate,
+            temperature=args.temperature, top_k=args.top_k,
+            seed=args.seed))
+        body = bytes(int(x) for x in out[0])
+        rprint(f"prompt: {bytes(int(x) for x in prompt[0])!r}")
+        rprint(f"sample: {body!r}")
     return loss
 
 
