@@ -77,12 +77,17 @@ class DevicePrefetcher:
         placed batches don't stay pinned in device memory."""
         self._stop.set()
         self._done = True
-        while True:  # drain so the producer's pending put unblocks
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
+
+        def drain():
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    return
+
+        drain()  # unblock a producer parked in put()
         self._thread.join(timeout=5)
+        drain()  # a pending put may have slipped in before the stop check
 
     def __enter__(self):
         return self

@@ -68,18 +68,36 @@ SCHEDULES = {"constant": constant, "linear": warmup_linear,
 # -------------------------------------------------------------- clipping
 
 
+def _varying_axes(x, axes: tuple) -> tuple:
+    """The subset of `axes` the value actually varies over (shard_map VMA
+    typing). A leaf invariant over an axis is already fully reduced there
+    — psumming it would count it axis-size times. Outside shard_map (or
+    without VMA introspection) fall back to psumming every axis."""
+    try:
+        vma = jax.typeof(x).vma
+    except Exception:
+        return tuple(axes)
+    return tuple(a for a in axes if a in vma)
+
+
 def global_norm(grads: Any, axes: tuple = ()) -> jax.Array:
     """L2 norm over every leaf of the gradient pytree (f32 accumulation).
 
-    `axes`: mesh axis names to `lax.psum` the squared sum over — required
+    `axes`: mesh axis names to `lax.psum` squared sums over — required
     when called inside `shard_map` with grads *sharded* over those axes
-    (e.g. per-stage grads over 'pp' in the SPMD pipeline engine), so the
-    norm is the true global one, not the local shard's."""
+    (e.g. per-stage grads over 'pp' in the pipeline engines), so the norm
+    is the true global one, not the local shard's. Per-leaf variance is
+    respected: a pytree mixing pp-sharded block grads with replicated
+    (already-reduced) embedding grads sums each exactly once."""
     leaves = jax.tree_util.tree_leaves(grads)
-    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
-    if axes:
-        sq = jax.lax.psum(sq, axes)
-    return jnp.sqrt(sq)
+    total = jnp.float32(0.0)
+    for l in leaves:
+        sq = jnp.sum(jnp.square(l.astype(jnp.float32)))
+        ax = _varying_axes(sq, axes) if axes else ()
+        if ax:
+            sq = jax.lax.psum(sq, ax)
+        total = total + sq
+    return jnp.sqrt(total)
 
 
 def clip_by_global_norm(grads: Any, max_norm: float,

@@ -1,0 +1,277 @@
+"""Pipeline parallelism for the transformer family — SPMD GPipe derived
+by autodiff.
+
+The reference pipelines an MLP with a hand-written instruction stream:
+explicit FWD/BWD instructions, Send/Recv hops, per-microbatch stashes
+(`/root/reference/shallowspeed/pipe.py:184-299,330-466`). The MLP family
+here keeps that shape (`parallel/worker.py`, `parallel/spmd_pipeline.py`
+with hand-written VJPs). This engine pipelines the *transformer* the most
+TPU-native way available:
+
+- **One SPMD program.** Inside a single `shard_map` over ('dp', 'pp'),
+  every device runs the same tick loop (`lax.scan`); stage identity is
+  `lax.axis_index('pp')`, activations hop right via `lax.ppermute` each
+  tick. Transformer blocks are homogeneous, so per-stage params are just
+  the stacked block pytree sharded `P('pp')` on the layer axis — no
+  padding/masking gymnastics (contrast the heterogeneous-width MLP,
+  `spmd_pipeline.py`).
+- **The backward pipeline is DERIVED, not scheduled.** `jax.value_and_grad`
+  differentiates through the tick scan: the transpose of `ppermute` is the
+  reverse ppermute, the transpose of the scan is the reversed-tick scan —
+  i.e. exactly GPipe's all-FWD-then-all-BWD schedule with reversed
+  microbatch order (`pipe.py:234-235`), including the per-microbatch
+  activation stash (the scan's saved residuals). The reference hand-codes
+  ~300 lines of schedule + stash bookkeeping; here it is the transpose of
+  30.
+- **Timing invariant.** At tick t, stage s handles microbatch m = t - s;
+  stage s+1 consumes at t+1 what stage s produced at t, so valid data
+  always arrives on time. Inactive ticks compute on don't-care values
+  whose loss contribution is masked to zero — autodiff therefore sends
+  them zero cotangents, and they contribute nothing to gradients.
+- **Gradient reduction by variance typing.** Block params enter sharded
+  over 'pp' (dp-invariant): their gradient transpose inserts the psum
+  over 'dp' only. Embeddings/head enter replicated: their transpose
+  psums over ('dp', 'pp'). The DP all-reduce the reference interleaves
+  by hand (`pipe.py:302-327`) is, again, the transpose of a broadcast.
+
+Composes with mixed precision (`compute_dtype`) and remat (recompute each
+stage's blocks in the backward). MoE configs are rejected — experts
+compose with dp/ep (`parallel/expert.py`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.ops.attention import attention
+from shallowspeed_tpu.utils import pvary_over as _pvary
+
+tree_map = jax.tree_util.tree_map
+
+
+def stack_blocks(params: dict) -> dict:
+    """blocks: list of per-layer dicts -> one dict with a leading layer
+    axis on every leaf (the axis that shards over 'pp')."""
+    blocks = params["blocks"]
+    stacked = tree_map(lambda *ls: jnp.stack(ls), *blocks)
+    return {**{k: v for k, v in params.items() if k != "blocks"},
+            "blocks": stacked}
+
+
+def unstack_blocks(params: dict, n_layers: int) -> dict:
+    """Inverse of `stack_blocks` (canonical checkpoint layout)."""
+    stacked = params["blocks"]
+    blocks = [tree_map(lambda l: l[i], stacked) for i in range(n_layers)]
+    return {**{k: v for k, v in params.items() if k != "blocks"},
+            "blocks": blocks}
+
+
+class PipelineLMEngine:
+    """GPipe-parallel transformer trainer over a ('dp', 'pp') mesh.
+
+    tokens/targets: (B, T) with B sharded over dp; each dp shard is split
+    into `n_mubatches` microbatches that stream through the pp stages.
+    """
+
+    def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
+                 n_mubatches: int = 4, seed: int = 0):
+        assert mesh.axis_names == ("dp", "pp")
+        assert cfg.n_experts == 0, (
+            "PipelineLMEngine pipelines the dense family; MoE composes "
+            "with dp/ep (parallel/expert.py)")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dp, self.pp = mesh.devices.shape
+        assert cfg.n_layers % self.pp == 0, (
+            f"n_layers={cfg.n_layers} must be divisible by pp={self.pp}")
+        self.n_mu = n_mubatches
+        self.optimizer = optimizer
+
+        self.rep = NamedSharding(mesh, P())
+        self.row = NamedSharding(mesh, P("dp"))
+        host = stack_blocks(T.init(cfg, seed))
+        # stacked blocks shard their layer axis over pp; the rest replicate
+        self._pspecs = {
+            "tok_emb": P(), "pos_emb": P(), "ln_f": {"g": P(), "b": P()},
+            "head": {"W": P(), "b": P()},
+            "blocks": tree_map(lambda _: P("pp"), host["blocks"]),
+        }
+        self.params = jax.device_put(
+            host, tree_map(lambda s: NamedSharding(mesh, s), self._pspecs,
+                           is_leaf=lambda x: isinstance(x, P)))
+        template = optimizer.init(self.params)
+        self.opt_state = tree_map(
+            lambda l: l if isinstance(getattr(l, "sharding", None),
+                                      NamedSharding)
+            else jax.device_put(l, self.rep), template)
+        self._opt_specs = tree_map(
+            lambda l: (l.sharding.spec
+                       if isinstance(getattr(l, "sharding", None),
+                                     NamedSharding) else P()),
+            self.opt_state)
+        self._build()
+
+    # ---------------------------------------------------------------- build
+
+    def _build(self):
+        import copy
+
+        cfg = self.cfg
+        pp, n_mu = self.pp, self.n_mu
+        # block grads are pp-sharded inside the shard_map step: the
+        # clipping norm must psum over 'pp' (same pattern as
+        # spmd_pipeline.py; private copy, caller's optimizer untouched)
+        opt = copy.copy(self.optimizer)
+        opt.clip_axes = ("pp",)
+        attn = partial(attention, causal=True)
+        right = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def apply_blocks(blocks, x):
+            """This stage's l_local blocks; optionally rematerialized."""
+            def body(h, blk):
+                h, _aux = T._block(blk, h, cfg, attn)
+                return h, None
+
+            if cfg.remat:
+                body = jax.checkpoint(body)
+            x, _ = jax.lax.scan(body, x, blocks)
+            return x
+
+        def local_loss(params, tokens, targets):
+            """Inside shard_map: tokens/targets (n_mu, mubs, T) local rows.
+            Returns the global-mean NLL (invariant over the mesh)."""
+            s = jax.lax.axis_index("pp")
+            is_first, is_last = s == 0, s == pp - 1
+            params = T.cast_params(params, cfg.compute_dtype)
+            mubs, t = tokens.shape[1], tokens.shape[2]
+            pos = jnp.arange(t)
+
+            def tick(carry, tk):
+                cur, loss_acc = carry
+                m = jnp.clip(tk - s, 0, n_mu - 1)
+                active = (tk - s >= 0) & (tk - s < n_mu)
+                tok_m = jax.lax.dynamic_index_in_dim(tokens, m, 0, False)
+                x_own = (params["tok_emb"][tok_m]
+                         + params["pos_emb"][pos])
+                if cfg.compute_dtype is not None:
+                    x_own = x_own.astype(cfg.compute_dtype)
+                x_in = jnp.where(is_first, x_own, cur)
+                h = apply_blocks(params["blocks"], x_in)
+                # last stage: this microbatch's mean token NLL
+                hf = T._layernorm(params["ln_f"], h)
+                logits = T._dense(params["head"], hf).astype(jnp.float32)
+                tgt_m = jax.lax.dynamic_index_in_dim(targets, m, 0, False)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, tgt_m[..., None], axis=-1)[..., 0].mean()
+                loss_acc = loss_acc + jnp.where(active & is_last, nll, 0.0)
+                nxt = jax.lax.ppermute(h, "pp", right)
+                return (nxt, loss_acc), None
+
+            dt = cfg.compute_dtype or cfg.dtype
+            init = _pvary(
+                (jnp.zeros((mubs, t, cfg.d_model), dt), jnp.float32(0.0)),
+                ("pp", "dp"))
+            (_, loss_sum), _ = jax.lax.scan(
+                tick, init, jnp.arange(n_mu + pp - 1))
+            # loss_sum lives on the last stage; sum over pp collects it,
+            # mean over dp and microbatches recovers the global mean
+            return (jax.lax.psum(loss_sum, "pp") / n_mu).mean(), None
+
+        def grads_and_loss(params, tokens, targets):
+            (loss, _), grads = jax.value_and_grad(
+                local_loss, has_aux=True)(params, tokens, targets)
+            # variance typing does the reductions: block grads arrive
+            # psum'd over dp (params dp-invariant), embed/head grads
+            # psum'd over (dp, pp) (fully invariant)
+            return jax.lax.pmean(loss, "dp"), grads
+
+        pspecs, ospecs = self._pspecs, self._opt_specs
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(pspecs, ospecs, P(None, "dp"), P(None, "dp")),
+                 out_specs=(pspecs, ospecs, P()))
+        def _step(params, opt_state, tokens, targets):
+            loss, grads = grads_and_loss(params, tokens, targets)
+            # dp-mean gradient: psum'd sums / dp (tiles are equal-sized)
+            grads = tree_map(lambda g: g / self.dp, grads)
+            params, opt_state = opt.step(params, grads, opt_state)
+            return params, opt_state, loss
+
+        @jax.jit
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(pspecs, P(None, "dp"), P(None, "dp")),
+                 out_specs=P())
+        def _eval(params, tokens, targets):
+            loss, _ = local_loss(params, tokens, targets)
+            return jax.lax.pmean(loss, "dp")
+
+        self._step_fn = _step
+        self._eval_fn = _eval
+
+    # ----------------------------------------------------------------- data
+
+    def _split_mu(self, arr: np.ndarray):
+        b, t = arr.shape
+        assert b % (self.dp * self.n_mu) == 0, (
+            f"batch {b} must divide over dp={self.dp} x "
+            f"n_mubatches={self.n_mu}")
+        assert t <= self.cfg.max_seq
+        mubs = b // (self.dp * self.n_mu)
+        # (B, T) -> (n_mu, dp*mubs, T): microbatch-major so each dp shard
+        # of axis 1 holds rows of every microbatch
+        return jax.device_put(
+            np.ascontiguousarray(
+                arr.reshape(self.dp, self.n_mu, mubs, t)
+                .transpose(1, 0, 2, 3).reshape(self.n_mu, -1, t)),
+            NamedSharding(self.mesh, P(None, "dp")))
+
+    def place(self, arr) -> jax.Array:
+        if isinstance(arr, jax.Array):
+            return arr
+        return self._split_mu(arr)
+
+    # ---------------------------------------------------------------- steps
+
+    def train_batch_async(self, tokens, targets) -> jax.Array:
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, self.place(tokens),
+            self.place(targets))
+        return loss
+
+    def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        return float(self.train_batch_async(tokens, targets))
+
+    def eval_loss(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        return float(self._eval_fn(self.params, self.place(tokens),
+                                   self.place(targets)))
+
+    # -------------------------------------------- checkpoint interface
+
+    def get_canonical_params(self):
+        return unstack_blocks(jax.device_get(self.params),
+                              self.cfg.n_layers)
+
+    def set_canonical_params(self, params):
+        host = stack_blocks(tree_map(np.asarray, params))
+        self.params = jax.device_put(
+            host, tree_map(lambda s: NamedSharding(self.mesh, s),
+                           self._pspecs,
+                           is_leaf=lambda x: isinstance(x, P)))
+
+    def set_opt_state(self, state):
+        from shallowspeed_tpu.parallel.zero import replace_opt_state
+
+        self.opt_state = replace_opt_state(self, state)

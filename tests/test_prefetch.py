@@ -148,3 +148,14 @@ def test_context_manager_closes():
     with DevicePrefetcher(range(50), lambda x: x, depth=2) as it:
         assert next(it) == 0
     assert not it._thread.is_alive()
+
+
+def test_close_leaves_queue_empty():
+    """Even when the producer was parked mid-put, close() must not leave a
+    placed batch referenced by the queue."""
+    for _ in range(10):  # race-prone path: repeat to catch the window
+        it = DevicePrefetcher(range(100), lambda x: x, depth=1)
+        next(it)
+        it.close()
+        assert it._q.empty()
+        assert not it._thread.is_alive()

@@ -25,6 +25,12 @@ import numpy as np
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--dp", type=int, default=1, help="data-parallel degree")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel degree: GPipe over transformer "
+                        "blocks, backward schedule derived by autodiff "
+                        "(needs n_layers %% pp == 0)")
+    p.add_argument("--n-mubatches", type=int, default=4,
+                   help="microbatches per batch in the pipeline (--pp > 1)")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence/context-parallel degree (ring attention)")
     p.add_argument("--tp", type=int, default=1,
@@ -136,6 +142,12 @@ def train(args) -> float:
         raise SystemExit(f"--generate {args.generate} + the 16-token prompt "
                          f"exceeds --seq-len {args.seq_len} (= max_seq)")
     composite = args.sp > 1 and args.tp > 1
+    if args.pp > 1 and (args.sp > 1 or args.tp > 1 or args.ep > 1
+                        or args.experts or args.fsdp or args.zero1):
+        raise SystemExit("--pp composes with --dp only for now")
+    if args.pp > 1 and args.attn != "ring":
+        raise SystemExit(f"--attn {args.attn} is not available with --pp "
+                         "(the pipeline engine uses XLA attention)")
     if args.ep > 1 and (args.sp > 1 or args.tp > 1):
         raise SystemExit("--ep composes with --dp only (not --sp/--tp)")
     if args.fsdp and (args.ep > 1 or args.experts or args.zero1):
@@ -160,7 +172,7 @@ def train(args) -> float:
         raise SystemExit(f"--attn {args.attn} is not available with "
                          "--experts (the MoE engine uses XLA attention)")
     model_par = args.sp * args.tp if composite else max(args.tp, args.sp,
-                                                        args.ep)
+                                                        args.ep, args.pp)
     n_dev = len(jax.devices())
     if args.dp * model_par > n_dev:
         raise SystemExit(f"requested dp*model_parallel="
@@ -190,7 +202,14 @@ def train(args) -> float:
         opt_kw["weight_decay"] = args.weight_decay
     opt = OPTIMIZERS[args.optimizer](lr=lr, **opt_kw)
     devs = np.array(jax.devices()[: args.dp * model_par])
-    if composite:
+    if args.pp > 1:
+        from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+
+        mesh = Mesh(devs.reshape(args.dp, args.pp), ("dp", "pp"))
+        engine = PipelineLMEngine(cfg, opt, mesh,
+                                  n_mubatches=args.n_mubatches,
+                                  seed=args.seed)
+    elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.sp, args.tp),
