@@ -3,11 +3,74 @@
 Re-designs the capability set of juvi21/ShallowSpeed (reference at
 /root/reference) for TPU hardware: jit-compiled jax.numpy ops with
 hand-written VJPs, pure-functional stage-partitioned models, schedules as
-testable pure data driving a pipeline VM, and SPMD parallelism over a 2-D
-(dp, pp) `jax.sharding.Mesh` with XLA collectives (psum / ppermute) instead
-of mpi4py Iallreduce / Send / Recv.
+testable pure data driving a pipeline VM, and SPMD parallelism over
+`jax.sharding.Mesh` axes (dp / pp / sp / tp / ep) with XLA collectives
+(psum / ppermute / all_to_all) instead of mpi4py Iallreduce / Send / Recv.
+
+Public API (lazily imported so `import shallowspeed_tpu` stays cheap):
+
+    from shallowspeed_tpu import (
+        FusedDPEngine, SPMDPipelineEngine, PipelineExecutor,      # MLP
+        ContextParallelEngine, TensorParallelEngine,              # LM
+        ExpertParallelEngine, FSDPEngine, Composite3DEngine,
+        PipelineLMEngine,
+        TransformerConfig, generate,
+        SGD, MomentumSGD, Adam, AdamW, OPTIMIZERS, SCHEDULES,
+        checkpoint, distributed, metrics,
+    )
 """
 
 __version__ = "0.1.0"
 
 from shallowspeed_tpu.ops import functional  # noqa: F401
+
+_EXPORTS = {
+    # engines
+    "FusedDPEngine": "shallowspeed_tpu.engine",
+    "PipelineExecutor": "shallowspeed_tpu.parallel.worker",
+    "SPMDPipelineEngine": "shallowspeed_tpu.parallel.spmd_pipeline",
+    "ContextParallelEngine": "shallowspeed_tpu.parallel.context",
+    "TensorParallelEngine": "shallowspeed_tpu.parallel.tensor",
+    "ExpertParallelEngine": "shallowspeed_tpu.parallel.expert",
+    "FSDPEngine": "shallowspeed_tpu.parallel.fsdp",
+    "Composite3DEngine": "shallowspeed_tpu.parallel.composite",
+    "PipelineLMEngine": "shallowspeed_tpu.parallel.pipeline_lm",
+    # models
+    "TransformerConfig": "shallowspeed_tpu.models.transformer",
+    "MLPStage": "shallowspeed_tpu.models.mlp",
+    "generate": "shallowspeed_tpu.models.generate",
+    # optimizers
+    "SGD": "shallowspeed_tpu.optim",
+    "MomentumSGD": "shallowspeed_tpu.optim",
+    "Adam": "shallowspeed_tpu.optim",
+    "AdamW": "shallowspeed_tpu.optim",
+    "OPTIMIZERS": "shallowspeed_tpu.optim",
+    "SCHEDULES": "shallowspeed_tpu.optim",
+    # subsystem modules
+    "checkpoint": "shallowspeed_tpu.checkpoint",
+    "distributed": "shallowspeed_tpu.distributed",
+    "metrics": "shallowspeed_tpu.metrics",
+    "optim": "shallowspeed_tpu.optim",
+    "utils": "shallowspeed_tpu.utils",
+}
+
+_MODULE_EXPORTS = {"checkpoint", "distributed", "metrics", "optim", "utils"}
+
+__all__ = sorted(_EXPORTS) + ["functional"]
+
+
+def __getattr__(name):  # PEP 562 lazy re-exports
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(target)
+    value = mod if name in _MODULE_EXPORTS else getattr(mod, name)
+    globals()[name] = value  # cache for next access
+    return value
+
+
+def __dir__():
+    return __all__

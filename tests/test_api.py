@@ -1,0 +1,24 @@
+"""Public API surface (`shallowspeed_tpu/__init__.py` lazy exports)."""
+
+import shallowspeed_tpu as st
+
+
+def test_every_export_resolves():
+    for name in st.__all__:
+        assert getattr(st, name) is not None, name
+
+
+def test_function_vs_module_exports():
+    from shallowspeed_tpu.models.generate import generate as gen_fn
+    from shallowspeed_tpu.optim import Adam
+
+    assert st.generate is gen_fn          # function, not the module
+    assert st.Adam is Adam
+    assert st.checkpoint.__name__ == "shallowspeed_tpu.checkpoint"
+
+
+def test_unknown_attribute_raises():
+    import pytest
+
+    with pytest.raises(AttributeError, match="no attribute 'nope'"):
+        st.nope

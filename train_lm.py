@@ -103,6 +103,12 @@ def parse_args(argv=None):
     p.add_argument("--save-dir", type=str, default="")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--log-file", type=str, default="")
+    p.add_argument("--profile-dir", type=str, default="",
+                   help="write a jax.profiler trace of the training loop")
+    p.add_argument("--val-every", type=int, default=0,
+                   help="every N steps evaluate held-out loss/perplexity "
+                        "(--text: last 10%% of the file; synthetic: a "
+                        "disjoint seed stream)")
     p.add_argument("--platform", type=str, default=None,
                    choices=["cpu", "tpu"])
     p.add_argument("--host-devices", type=int, default=0)
@@ -256,11 +262,27 @@ def train(args) -> float:
     metrics = MetricsLogger(args.log_file, dp=args.dp, sp=args.sp,
                             seq_len=args.seq_len, d_model=args.d_model,
                             n_layers=args.n_layers)
-    text_data = None
+    text_data = val_data = None
     if args.text:
-        text_data = np.frombuffer(
+        raw = np.frombuffer(
             open(args.text, "rb").read(), np.uint8).astype(np.int32)
-        assert len(text_data) > args.seq_len + 1, "text too short for --seq-len"
+        assert len(raw) > args.seq_len + 1, "text too short for --seq-len"
+        if args.val_every:
+            split = max(int(len(raw) * 0.9), args.seq_len + 2)
+            text_data, val_data = raw[:split], raw[split:]
+            assert len(val_data) > args.seq_len + 1, (
+                "text too short to hold out a 10% validation tail")
+        else:
+            text_data = raw
+
+    def val_loss() -> float:
+        """Held-out loss: --text tail, or a seed stream disjoint from
+        training (steps are seeded [seed, step]; val uses [seed+1, ...])."""
+        val_args = args if val_data is not None else argparse.Namespace(
+            **{**vars(args), "seed": args.seed + 1})
+        tok, tgt = make_batch(val_args, vocab, 10**9, val_data)
+        return float(engine.eval_loss(local_rows(tok), local_rows(tgt)))
+
     t0 = time.time()
     loss = float("nan")
     from shallowspeed_tpu.data.prefetch import prefetch_to_device, sync_every
@@ -280,20 +302,37 @@ def train(args) -> float:
     placed = prefetch_to_device(
         batches(), lambda b: (engine.place(b[0]), engine.place(b[1])),
         depth=args.prefetch)
+    import contextlib
+
+    profile_ctx = (jax.profiler.trace(args.profile_dir)
+                   if args.profile_dir else contextlib.nullcontext())
     try:
-        for step, (tok, tgt) in zip(range(start_step, args.steps), placed):
-            loss_dev = engine.train_batch_async(tok, tgt)
-            if sync_every(step, args.log_every, args.steps):
-                loss = float(loss_dev)
-                toks_s = (args.batch_size * args.seq_len
-                          * (step - start_step + 1) / (time.time() - t0))
-                rprint(f"step {step:5d}  loss {loss:.4f}  "
-                       f"tok/s {toks_s:,.0f}")
-                metrics.log(event="step", step=step, loss=round(loss, 6),
-                            tokens_per_sec=round(toks_s, 1))
-            if args.save_dir and ((step + 1) % args.save_every == 0
-                                  or step == args.steps - 1):
-                checkpoint.save(args.save_dir, engine, step)
+        with profile_ctx:
+            for step, (tok, tgt) in zip(range(start_step, args.steps),
+                                        placed):
+                loss_dev = engine.train_batch_async(tok, tgt)
+                if sync_every(step, args.log_every, args.steps):
+                    loss = float(loss_dev)
+                    toks_s = (args.batch_size * args.seq_len
+                              * (step - start_step + 1)
+                              / (time.time() - t0))
+                    rprint(f"step {step:5d}  loss {loss:.4f}  "
+                           f"tok/s {toks_s:,.0f}")
+                    metrics.log(event="step", step=step,
+                                loss=round(loss, 6),
+                                tokens_per_sec=round(toks_s, 1))
+                if args.val_every and ((step + 1) % args.val_every == 0
+                                       or step == args.steps - 1):
+                    vl = val_loss()
+                    rprint(f"step {step:5d}  val_loss {vl:.4f}  "
+                           f"ppl {np.exp(min(vl, 20)):,.2f}")
+                    metrics.log(event="val", step=step,
+                                val_loss=round(vl, 6),
+                                perplexity=round(float(np.exp(min(vl, 20))),
+                                                 3))
+                if args.save_dir and ((step + 1) % args.save_every == 0
+                                      or step == args.steps - 1):
+                    checkpoint.save(args.save_dir, engine, step)
     finally:
         # abandoning mid-stream must not leave placed batches pinned on
         # device by a blocked producer thread
