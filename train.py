@@ -46,7 +46,11 @@ def parse_args(argv=None):
     p.add_argument("--mubatches", type=int, default=N_MUBATCHES)
     p.add_argument("--lr", type=float, default=LR)
     p.add_argument("--optimizer", type=str, default="sgd",
-                   choices=["sgd", "momentum", "adam"])
+                   choices=["sgd", "momentum", "adam", "adamw"])
+    p.add_argument("--grad-clip", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--weight-decay", type=float, default=0.01,
+                   help="decoupled weight decay (adamw only)")
     p.add_argument("--data-dir", type=str, default="data/mnist_784")
     p.add_argument("--max-batches", type=int, default=0,
                    help="limit batches per epoch (0 = all); for smoke tests")
@@ -110,7 +114,10 @@ def build(args):
             f"requested dp*pp={dp * pp} devices but only {n_devices} present")
 
     mesh = make_mesh(dp, pp)
-    optimizer = OPTIMIZERS[args.optimizer](lr=args.lr)
+    opt_kw = {"grad_clip": args.grad_clip or None}
+    if args.optimizer == "adamw":
+        opt_kw["weight_decay"] = args.weight_decay
+    optimizer = OPTIMIZERS[args.optimizer](lr=args.lr, **opt_kw)
 
     data_dir = ensure_mnist(Path(args.data_dir))
     local_bs = args.batch_size // dp

@@ -275,15 +275,23 @@ def train(args) -> float:
         else:
             text_data = raw
 
+    n_evals = 0
+
     def val_loss() -> float:
         """Held-out loss: --text tail, or a seed stream disjoint from
-        training (steps are seeded [seed, step]; val uses [seed+1, ...])."""
+        training (steps are seeded [seed, step]; val uses [seed+1, ...]).
+        Each call draws a FRESH batch of held-out windows (seeded by the
+        eval counter) so the metric tracks the distribution, not a fixed
+        handful of examples."""
+        nonlocal n_evals
+        n_evals += 1
         val_args = args if val_data is not None else argparse.Namespace(
             **{**vars(args), "seed": args.seed + 1})
-        tok, tgt = make_batch(val_args, vocab, 10**9, val_data)
+        tok, tgt = make_batch(val_args, vocab, 10**9 + n_evals, val_data)
         return float(engine.eval_loss(local_rows(tok), local_rows(tgt)))
 
     t0 = time.time()
+    val_time = 0.0  # excluded from tok/s (val syncs + compiles once)
     loss = float("nan")
     from shallowspeed_tpu.data.prefetch import prefetch_to_device, sync_every
     from shallowspeed_tpu.distributed import local_rows
@@ -313,9 +321,24 @@ def train(args) -> float:
                 loss_dev = engine.train_batch_async(tok, tgt)
                 if sync_every(step, args.log_every, args.steps):
                     loss = float(loss_dev)
+                    if not np.isfinite(loss):
+                        # failure detection: divergence gets a labeled exit
+                        # (and the params snapshot when --save-dir is set)
+                        # instead of silently training on NaNs
+                        if args.save_dir:
+                            # under diverged/ so checkpoint.latest() keeps
+                            # resolving to the last GOOD checkpoint for
+                            # --resume; this snapshot is forensic only
+                            path = checkpoint.save(
+                                f"{args.save_dir}/diverged", engine, step)
+                            rprint(f"diverged-state snapshot: {path}")
+                        raise SystemExit(
+                            f"loss became non-finite ({loss}) at step "
+                            f"{step}; try --grad-clip, a lower --lr, or "
+                            f"--lr-schedule with --warmup-steps")
                     toks_s = (args.batch_size * args.seq_len
                               * (step - start_step + 1)
-                              / (time.time() - t0))
+                              / (time.time() - t0 - val_time))
                     rprint(f"step {step:5d}  loss {loss:.4f}  "
                            f"tok/s {toks_s:,.0f}")
                     metrics.log(event="step", step=step,
@@ -323,7 +346,9 @@ def train(args) -> float:
                                 tokens_per_sec=round(toks_s, 1))
                 if args.val_every and ((step + 1) % args.val_every == 0
                                        or step == args.steps - 1):
+                    tv = time.time()
                     vl = val_loss()
+                    val_time += time.time() - tv
                     rprint(f"step {step:5d}  val_loss {vl:.4f}  "
                            f"ppl {np.exp(min(vl, 20)):,.2f}")
                     metrics.log(event="val", step=step,
