@@ -64,7 +64,7 @@ def attention(q: Array, k: Array, v: Array, causal: bool = True) -> Array:
 
 
 def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,
-                      causal: bool = True) -> Array:
+                      causal: bool = True, use_flash: bool = False) -> Array:
     """All-to-all (Ulysses) attention over the sequence-sharded `axis_name`.
 
     q, k, v: (batch, seq_local, heads, head_dim) — this device's sequence
@@ -78,6 +78,14 @@ def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,
     mesh-axis order, so the gathered sequence axis is already in global
     order and the plain causal mask is correct. After local full attention,
     the reverse all-to-all restores sequence sharding.
+
+    `use_flash` swaps the local attention for the fused Pallas flash
+    kernel (`ops/flash_attention.py`): because each device holds the FULL
+    gathered sequence for its head subset, the kernel's standard causal
+    mask applies unchanged — sequence parallelism and the flash kernel
+    compose with no kernel modifications (unlike the ring formulation,
+    which would need cross-block position-offset masking inside the
+    kernel).
     """
     n = lax.psum(1, axis_name)
     h = q.shape[2]
@@ -89,7 +97,14 @@ def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
-    o = attention(gather_seq(q), gather_seq(k), gather_seq(v), causal=causal)
+    if use_flash:
+        from shallowspeed_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(gather_seq(q), gather_seq(k), gather_seq(v),
+                            causal=causal)
+    else:
+        o = attention(gather_seq(q), gather_seq(k), gather_seq(v),
+                      causal=causal)
     # (b, t, h/n, d) -> (b, t/n, h, d)
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)

@@ -47,6 +47,9 @@ class ContextParallelEngine:
     - "ulysses": `ulysses_attention` — all-to-all head<->sequence
       re-sharding around one fused full-attention program; needs
       n_heads % sp == 0.
+    - "ulysses-flash": same all-to-all re-sharding, but the local
+      attention is the fused Pallas flash kernel — sequence parallelism
+      AND the flash kernel's O(T) memory / fused softmax in one path.
     - "flash": the fused Pallas flash kernel
       (`ops/flash_attention.py`) — sp must be 1 (sequence unsharded);
       fastest single-device path on TPU.
@@ -71,11 +74,12 @@ class ContextParallelEngine:
 
             assert self.sp == 1, "--attn flash requires sp=1 (use ring)"
             attn = partial(flash_attention, causal=True)
-        elif attn == "ulysses":
+        elif attn in ("ulysses", "ulysses-flash"):
             assert cfg.n_heads % self.sp == 0, (
-                f"--attn ulysses needs n_heads ({cfg.n_heads}) divisible by "
+                f"--attn {attn} needs n_heads ({cfg.n_heads}) divisible by "
                 f"sp ({self.sp}); use ring")
-            attn = partial(ulysses_attention, axis_name="sp", causal=True)
+            attn = partial(ulysses_attention, axis_name="sp", causal=True,
+                           use_flash=attn == "ulysses-flash")
         else:
             attn = partial(ring_attention, axis_name="sp", causal=True)
 

@@ -97,11 +97,12 @@ def test_ring_gradients_match_full(qkv):
                                    rtol=5e-4, atol=5e-5)
 
 
-def ulysses_on_mesh(q, k, v, sp, causal):
+def ulysses_on_mesh(q, k, v, sp, causal, use_flash=False):
     mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
     spec = P(None, "sp")
     fn = shard_map(
-        partial(ulysses_attention, axis_name="sp", causal=causal),
+        partial(ulysses_attention, axis_name="sp", causal=causal,
+                use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return np.asarray(jax.jit(fn)(q, k, v))
 
@@ -157,3 +158,38 @@ def test_ring_long_sequence_small_blocks():
     want = np.asarray(attention(q, k, v, causal=True))
     got = ring_on_mesh(q, k, v, sp=8, causal=True)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("sp", [1, 2, 4])
+def test_ulysses_flash_matches_full(qkv, sp):
+    """All-to-all sequence parallelism with the Pallas flash kernel as the
+    local attention must equal plain full attention."""
+    q, k, v = qkv
+    want = np.asarray(attention(q, k, v, causal=True))
+    got = ulysses_on_mesh(q, k, v, sp, True, use_flash=True)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_flash_gradients_match_full(qkv):
+    """The flash kernel's custom VJP composes with the all-to-all
+    transposes: gradients must equal the full-attention gradients."""
+    q, k, v = qkv
+    sp = 4
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+    spec = P(None, "sp")
+
+    def full_loss(q, k, v):
+        return (attention(q, k, v, causal=True) ** 2).sum()
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=P())
+    def uf_loss(q, k, v):
+        o = ulysses_attention(q, k, v, axis_name="sp", causal=True,
+                              use_flash=True)
+        return jax.lax.psum((o.astype(jnp.float32) ** 2).sum(), "sp")
+
+    want = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(uf_loss, argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=5e-4, atol=5e-5)

@@ -78,3 +78,28 @@ def test_transformer_with_flash_attention():
                     jax.tree_util.tree_leaves(g_flash)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-4, atol=1e-5)
+
+
+def test_streaming_fwd_matches_resident(monkeypatch):
+    """Force the streaming (3-D grid + scratch) forward and check it equals
+    the resident fast path — the CPU suite's small shapes otherwise only
+    exercise the resident branch."""
+    import shallowspeed_tpu.ops.flash_attention as fa
+
+    rng = np.random.default_rng(11)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 256, 2, 16)), jnp.float32)
+               for _ in range(3))
+    want = np.asarray(fa.flash_attention(q, k, v, causal=True))
+    monkeypatch.setattr(fa, "_RESIDENT_KV_ELEMS", 0)
+    got = np.asarray(fa.flash_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def loss(fn):
+        return lambda *a: (fn(*a, True) ** 2).sum()
+
+    g_stream = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.undo()
+    g_res = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_stream, g_res):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

@@ -93,7 +93,7 @@ def test_attention_bf16_close_to_f32():
     np.testing.assert_allclose(o16, o32, atol=0.03, rtol=0.05)
 
 
-@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+@pytest.mark.parametrize("attn", ["ring", "ulysses", "ulysses-flash"])
 def test_bf16_engine_trains(attn):
     """End-to-end: (dp=2, sp=2) mesh, bf16 compute — loss decreases and the
     master params/opt state stay f32."""

@@ -79,12 +79,13 @@ def parse_args(argv=None):
                         "(1/dp per-device Adam moment footprint; GSPMD "
                         "derives the reduce/all-gather pattern)")
     p.add_argument("--attn", default="ring",
-                   choices=["ring", "ulysses", "flash"],
+                   choices=["ring", "ulysses", "ulysses-flash", "flash"],
                    help="attention substrate: ring (any --sp), ulysses "
-                        "(all-to-all; needs n_heads %% sp == 0) or the "
-                        "fused Pallas flash kernel (--sp 1 only); with "
-                        "--tp/--fsdp the GSPMD engines use XLA attention "
-                        "(K/V all-gather under --sp)")
+                        "(all-to-all; needs n_heads %% sp == 0), "
+                        "ulysses-flash (all-to-all + fused Pallas kernel) "
+                        "or the fused Pallas flash kernel (--sp 1 only); "
+                        "with --tp/--fsdp the GSPMD engines use XLA "
+                        "attention (K/V all-gather under --sp)")
     p.add_argument("--text", type=str, default="",
                    help="train on this UTF-8 text file (byte-level vocab)")
     p.add_argument("--generate", type=int, default=0,
@@ -346,6 +347,9 @@ def train(args) -> float:
                                 tokens_per_sec=round(toks_s, 1))
                 if args.val_every and ((step + 1) % args.val_every == 0
                                        or step == args.steps - 1):
+                    # drain queued TRAIN work first, so its wall time isn't
+                    # booked as val time (val points need not be log points)
+                    jax.block_until_ready(loss_dev)
                     tv = time.time()
                     vl = val_loss()
                     val_time += time.time() - tv
