@@ -8,13 +8,18 @@ here keeps that shape (`parallel/worker.py`, `parallel/spmd_pipeline.py`
 with hand-written VJPs). This engine pipelines the *transformer* the most
 TPU-native way available:
 
-- **One SPMD program.** Inside a single `shard_map` over ('dp', 'pp'),
-  every device runs the same tick loop (`lax.scan`); stage identity is
-  `lax.axis_index('pp')`, activations hop right via `lax.ppermute` each
-  tick. Transformer blocks are homogeneous, so per-stage params are just
-  the stacked block pytree sharded `P('pp')` on the layer axis — no
-  padding/masking gymnastics (contrast the heterogeneous-width MLP,
-  `spmd_pipeline.py`).
+- **One SPMD program.** Inside a single `shard_map` over ('dp', 'pp')
+  — or ('dp', 'pp', 'tp') — every device runs the same tick loop
+  (`lax.scan`); stage identity is `lax.axis_index('pp')`, activations
+  hop right via `lax.ppermute` each tick. Transformer blocks are
+  homogeneous, so per-stage params are just the stacked block pytree
+  sharded `P('pp')` on the layer axis — no padding/masking gymnastics
+  (contrast the heterogeneous-width MLP, `spmd_pipeline.py`). With a tp
+  axis, each stage's blocks additionally take the Megatron placement
+  (qkv/up column-sharded into whole head groups, proj/down row-sharded
+  with an explicit `lax.psum` over 'tp' — hand-placed, since GSPMD does
+  not see inside shard_map), composing data x pipeline x tensor
+  parallelism in one compiled program.
 - **The backward pipeline is DERIVED, not scheduled.** `jax.value_and_grad`
   differentiates through the tick scan: the transpose of `ppermute` is the
   reverse ppermute, the transpose of the scan is the reversed-tick scan —
@@ -78,7 +83,11 @@ def unstack_blocks(params: dict, n_layers: int) -> dict:
 
 
 class PipelineLMEngine:
-    """GPipe-parallel transformer trainer over a ('dp', 'pp') mesh.
+    """GPipe-parallel transformer trainer over a ('dp', 'pp') or
+    ('dp', 'pp', 'tp') mesh — with the tp axis, each pipeline stage's
+    blocks are additionally Megatron-sharded (explicit psum over 'tp'
+    inside the shard_map, since GSPMD is not in play here), composing
+    data, pipeline, and tensor parallelism in one compiled program.
 
     tokens/targets: (B, T) with B sharded over dp; each dp shard is split
     into `n_mubatches` microbatches that stream through the pp stages.
@@ -86,26 +95,45 @@ class PipelineLMEngine:
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  n_mubatches: int = 4, seed: int = 0):
-        assert mesh.axis_names == ("dp", "pp")
+        assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp")), (
+            f"PipelineLMEngine expects a ('dp','pp'[,'tp']) mesh, got "
+            f"{mesh.axis_names}")
         assert cfg.n_experts == 0, (
             "PipelineLMEngine pipelines the dense family; MoE composes "
             "with dp/ep (parallel/expert.py)")
         self.cfg = cfg
         self.mesh = mesh
-        self.dp, self.pp = mesh.devices.shape
+        self.dp, self.pp = mesh.devices.shape[:2]
+        self.tp = mesh.devices.shape[2] if len(mesh.axis_names) == 3 else 1
+        self.has_tp = len(mesh.axis_names) == 3
         assert cfg.n_layers % self.pp == 0, (
             f"n_layers={cfg.n_layers} must be divisible by pp={self.pp}")
+        assert cfg.n_heads % self.tp == 0, (
+            f"n_heads={cfg.n_heads} must be divisible by tp={self.tp}")
+        assert (4 * cfg.d_model) % self.tp == 0
         self.n_mu = n_mubatches
         self.optimizer = optimizer
 
         self.rep = NamedSharding(mesh, P())
         self.row = NamedSharding(mesh, P("dp"))
         host = stack_blocks(T.init(cfg, seed))
-        # stacked blocks shard their layer axis over pp; the rest replicate
+        # stacked blocks shard their layer axis over pp; with a tp axis the
+        # feature dims additionally take the Megatron placement (qkv/up
+        # column-sharded — whole head groups, thanks to the head-major
+        # fused qkv layout — proj/down row-sharded, their biases applied
+        # once after the tp psum). Embeddings/head replicate.
+        if self.has_tp:
+            col = {"W": P("pp", None, "tp"), "b": P("pp", "tp")}
+            rowp = {"W": P("pp", "tp", None), "b": P("pp")}
+            ln = {"g": P("pp"), "b": P("pp")}
+            blocks_spec = {"ln1": ln, "qkv": col, "proj": rowp,
+                           "ln2": ln, "up": col, "down": rowp}
+        else:
+            blocks_spec = tree_map(lambda _: P("pp"), host["blocks"])
         self._pspecs = {
             "tok_emb": P(), "pos_emb": P(), "ln_f": {"g": P(), "b": P()},
             "head": {"W": P(), "b": P()},
-            "blocks": tree_map(lambda _: P("pp"), host["blocks"]),
+            "blocks": blocks_spec,
         }
         self.params = jax.device_put(
             host, tree_map(lambda s: NamedSharding(mesh, s), self._pspecs,
@@ -129,19 +157,45 @@ class PipelineLMEngine:
 
         cfg = self.cfg
         pp, n_mu = self.pp, self.n_mu
-        # block grads are pp-sharded inside the shard_map step: the
-        # clipping norm must psum over 'pp' (same pattern as
-        # spmd_pipeline.py; private copy, caller's optimizer untouched)
+        # block grads are sharded over 'pp' (and feature-sharded over 'tp')
+        # inside the shard_map step: the clipping norm psums each leaf over
+        # exactly the axes it varies on (VMA-aware global_norm); private
+        # copy, caller's optimizer untouched
         opt = copy.copy(self.optimizer)
-        opt.clip_axes = ("pp",)
-        attn = partial(attention, causal=True)
+        opt.clip_axes = ("pp", "tp") if self.has_tp else ("pp",)
         right = [(i, (i + 1) % pp) for i in range(pp)]
+        heads_local = cfg.n_heads // self.tp
+        hd = cfg.head_dim
+
+        if self.has_tp:
+            def psum_tp(x):
+                return jax.lax.psum(x, "tp")
+        else:
+            def psum_tp(x):
+                return x
+
+        def mega_block(blk, x):
+            """One pre-LN block on this device's tp shard: qkv/up columns
+            hold `heads_local` whole heads / `4d/tp` neurons, proj/down
+            rows are partial-summed over 'tp' (one all-reduce per matmul
+            pair, Megatron placement). With tp absent this is exactly
+            `T._block`'s dense path."""
+            b, t, d = x.shape
+            h = T._layernorm(blk["ln1"], x)
+            qkv = (h @ blk["qkv"]["W"] + blk["qkv"]["b"]).reshape(
+                b, t, heads_local, 3, hd)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            a = attention(q, k, v, causal=True).reshape(
+                b, t, heads_local * hd)
+            x = x + psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"]
+            h = T._layernorm(blk["ln2"], x)
+            u = jax.nn.gelu(h @ blk["up"]["W"] + blk["up"]["b"])
+            return x + psum_tp(u @ blk["down"]["W"]) + blk["down"]["b"]
 
         def apply_blocks(blocks, x):
             """This stage's l_local blocks; optionally rematerialized."""
             def body(h, blk):
-                h, _aux = T._block(blk, h, cfg, attn)
-                return h, None
+                return mega_block(blk, h), None
 
             if cfg.remat:
                 body = jax.checkpoint(body)

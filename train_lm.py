@@ -34,9 +34,10 @@ def parse_args(argv=None):
     p.add_argument("--sp", type=int, default=1,
                    help="sequence/context-parallel degree (ring attention)")
     p.add_argument("--tp", type=int, default=1,
-                   help="tensor-parallel degree (Megatron placement via "
-                        "GSPMD); with --sp > 1 both run on one 3-D "
-                        "(dp, sp, tp) mesh")
+                   help="tensor-parallel degree (Megatron placement); "
+                        "composes with --sp on a (dp, sp, tp) mesh "
+                        "(GSPMD) or with --pp on a (dp, pp, tp) mesh "
+                        "(explicit psum inside the pipeline)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel degree (requires --experts > 0); "
                         "composes with --dp only")
@@ -149,9 +150,9 @@ def train(args) -> float:
         raise SystemExit(f"--generate {args.generate} + the 16-token prompt "
                          f"exceeds --seq-len {args.seq_len} (= max_seq)")
     composite = args.sp > 1 and args.tp > 1
-    if args.pp > 1 and (args.sp > 1 or args.tp > 1 or args.ep > 1
-                        or args.experts or args.fsdp or args.zero1):
-        raise SystemExit("--pp composes with --dp only for now")
+    if args.pp > 1 and (args.sp > 1 or args.ep > 1 or args.experts
+                        or args.fsdp or args.zero1):
+        raise SystemExit("--pp composes with --dp and --tp only for now")
     if args.pp > 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with --pp "
                          "(the pipeline engine uses XLA attention)")
@@ -178,8 +179,12 @@ def train(args) -> float:
     if args.experts and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with "
                          "--experts (the MoE engine uses XLA attention)")
-    model_par = args.sp * args.tp if composite else max(args.tp, args.sp,
-                                                        args.ep, args.pp)
+    if composite:
+        model_par = args.sp * args.tp
+    elif args.pp > 1:
+        model_par = args.pp * args.tp
+    else:
+        model_par = max(args.tp, args.sp, args.ep)
     n_dev = len(jax.devices())
     if args.dp * model_par > n_dev:
         raise SystemExit(f"requested dp*model_parallel="
@@ -212,7 +217,11 @@ def train(args) -> float:
     if args.pp > 1:
         from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
 
-        mesh = Mesh(devs.reshape(args.dp, args.pp), ("dp", "pp"))
+        if args.tp > 1:
+            mesh = Mesh(devs.reshape(args.dp, args.pp, args.tp),
+                        ("dp", "pp", "tp"))
+        else:
+            mesh = Mesh(devs.reshape(args.dp, args.pp), ("dp", "pp"))
         engine = PipelineLMEngine(cfg, opt, mesh,
                                   n_mubatches=args.n_mubatches,
                                   seed=args.seed)
