@@ -77,15 +77,20 @@ def numpy_baseline_step_fn():
 
 def bench_numpy(xs, ys, n_batches=60) -> float:
     """Sustained NumPy samples/sec, measured over a subset and scaled (the
-    full 20-epoch run would take minutes)."""
+    full 20-epoch run would take minutes). Best of 3 runs: host/BLAS load
+    jitter only ever makes NumPy look slower, so taking its fastest run
+    keeps `vs_baseline` conservative and stable across invocations."""
     step = numpy_baseline_step_fn()
     for _ in range(3):
         step(xs, ys)
-    t0 = time.perf_counter()
-    for _ in range(n_batches):
-        step(xs, ys)
-    dt = time.perf_counter() - t0
-    return n_batches * GBS / dt
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            step(xs, ys)
+        dt = time.perf_counter() - t0
+        best = max(best, n_batches * GBS / dt)
+    return best
 
 
 # ------------------------------------------------------------ jax/tpu side
