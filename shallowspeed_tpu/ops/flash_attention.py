@@ -24,7 +24,7 @@ reduction, so VMEM holds one (block_q, block_k) tile's operands at a time
 
 Every entry point picks between this streaming form and a resident fast
 path (whole K/V — or Q/dO/stats for dkv — held in VMEM with a fori_loop
-reduction) when the sequence fits `_RESIDENT_KV_ELEMS`; resident is ~10%
+reduction) when the sequence fits `_RESIDENT_BYTES`; resident is ~10%
 faster at T=8k (no per-tile scratch round-trips) and its causal loop
 bounds skip masked tiles' DMA entirely. In the streaming form, causal
 masking drops fully-masked tiles' COMPUTE with `pl.when` (whole-tile
@@ -67,7 +67,10 @@ def _interpret_default() -> bool:
 # V comfortably fit VMEM next to the working blocks, and the single-kernel
 # fori_loop formulation avoids the streaming version's per-tile scratch
 # round-trips (~10% at T=8k measured). Above it, stream (VMEM-unbounded).
-_RESIDENT_KV_ELEMS = 1 << 19  # 512k elems = 1MB bf16 / 2MB f32 per operand
+# Byte-based (dtype-aware): 8k x 64 f32 K/V picks streaming while the same
+# shape in bf16 stays resident — an element-count gate let the f32 case
+# overflow the 16MB scoped-vmem ceiling by a hair.
+_RESIDENT_BYTES = 1 << 20  # 1MB per whole-sequence operand held in VMEM
 
 
 def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
@@ -405,7 +408,7 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         _sds((bh, tq, d), q.dtype, q3),
         _sds((bh, tq, _LANES), jnp.float32, q3),
     ]
-    if tk * d <= _RESIDENT_KV_ELEMS:
+    if tk * d * q.dtype.itemsize <= _RESIDENT_BYTES:
         kernel = functools.partial(
             _fwd_kernel_resident, scale=scale, causal=causal, block_q=bq,
             block_k=bk, seq_k=tk)
@@ -483,7 +486,7 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
     # that, the reduction axis runs as the innermost grid dimension
     # revisiting an f32 output block — VMEM per step is O(block^2),
     # independent of T.
-    dq_resident = tk * d <= _RESIDENT_KV_ELEMS
+    dq_resident = tk * d * q.dtype.itemsize <= _RESIDENT_BYTES
     if dq_resident:
         dq_kernel = functools.partial(
             _dq_kernel_resident, scale=scale, causal=causal, block_q=bq,
@@ -525,8 +528,11 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
             interpret=interpret,
         )(q3, k3, v3, do3, lse, delta)
 
-    dkv_resident = (tq * d <= _RESIDENT_KV_ELEMS
-                    and tq * _LANES <= _RESIDENT_KV_ELEMS)
+    # lse/delta stats are always f32 and get a deliberate 2x allowance
+    # (preserves the pre-byte-gate bound: bf16 resident up to T=4096)
+    stats_bytes = tq * _LANES * jnp.dtype(jnp.float32).itemsize
+    dkv_resident = (tq * d * q.dtype.itemsize <= _RESIDENT_BYTES
+                    and stats_bytes <= 2 * _RESIDENT_BYTES)
     if dkv_resident:
         dkv_kernel = functools.partial(
             _dkv_kernel_resident, scale=scale, causal=causal, block_q=bq,

@@ -90,7 +90,7 @@ def test_streaming_fwd_matches_resident(monkeypatch):
     q, k, v = (jnp.asarray(rng.normal(size=(2, 256, 2, 16)), jnp.float32)
                for _ in range(3))
     want = np.asarray(fa.flash_attention(q, k, v, causal=True))
-    monkeypatch.setattr(fa, "_RESIDENT_KV_ELEMS", 0)
+    monkeypatch.setattr(fa, "_RESIDENT_BYTES", 0)
     got = np.asarray(fa.flash_attention(q, k, v, causal=True))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
