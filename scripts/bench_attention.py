@@ -75,8 +75,8 @@ def main():
             for _ in range(iters):
                 out = fn(*xs)
             jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
-            dt = max(time.perf_counter() - t0 - sync_s, 1e-9)
-            best = min(best, dt / iters)
+            elapsed = max(time.perf_counter() - t0 - sync_s, 1e-9)
+            best = min(best, elapsed / iters)
         return best
 
     for t in args.seqs:

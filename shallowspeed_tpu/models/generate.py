@@ -63,6 +63,9 @@ def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
     h = T._layernorm(p["ln1"], x)
     qkv = T._dense(p["qkv"], h).reshape(b, 1, cfg.n_heads, 3, cfg.head_dim)
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    if cfg.rope:  # rotate at this token's position; cache stores rotated K
+        q = T.rope_rotate(q, pos, cfg.rope_theta)
+        k = T.rope_rotate(k, pos, cfg.rope_theta)
     cache_blk = {
         "k": jax.lax.dynamic_update_slice_in_dim(
             cache_blk["k"], k.astype(cache_blk["k"].dtype), pos, axis=1),
@@ -79,7 +82,9 @@ def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
 def _embed(params, tokens, pos0, cfg):
     t = tokens.shape[1]
     pos = pos0 + jnp.arange(t)
-    x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    x = params["tok_emb"][tokens]
+    if not cfg.rope:  # rope replaces the learned absolute embedding
+        x = x + params["pos_emb"][pos]
     if cfg.compute_dtype is not None:
         x = x.astype(cfg.compute_dtype)
     return x
@@ -94,8 +99,10 @@ def prefill(params, tokens, cfg: T.TransformerConfig, cache):
     tp = tokens.shape[1]
     x = _embed(params, tokens, 0, cfg)
     attn = partial(T.attention, causal=True)
+    pos = jnp.arange(tp)
     for i, blk in enumerate(params["blocks"]):
-        x, _aux, (k, v) = T._block(blk, x, cfg, attn, with_kv=True)
+        x, _aux, (k, v) = T._block(blk, x, cfg, attn, with_kv=True,
+                                   pos=pos)
         cache[i] = {
             "k": jax.lax.dynamic_update_slice_in_dim(
                 cache[i]["k"], k.astype(cache[i]["k"].dtype), 0, axis=1),

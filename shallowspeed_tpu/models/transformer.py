@@ -53,6 +53,15 @@ class TransformerConfig:
     # ~1 extra forward of FLOPs for O(n_layers) -> O(1) activation memory —
     # the standard long-context lever on HBM-bound TPUs.
     remat: bool = False
+    # Rotary position embeddings (Su et al., RoFormer): rotate q/k by
+    # per-position phases inside every block instead of adding a learned
+    # absolute embedding (pos_emb is kept in the pytree for structural
+    # stability across engines but NOT added when rope is on). Positions
+    # are global, so RoPE composes with sequence sharding unchanged: each
+    # device rotates its local q/k block by its global positions before
+    # the ring/all-to-all ever moves K.
+    rope: bool = False
+    rope_theta: float = 10000.0
     # Mixture-of-experts (0 = dense FFN everywhere). With n_experts > 0 every
     # block's FFN becomes a top-k routed MoE (`ops/moe.py`) — the family the
     # reference lacks entirely (SURVEY §2: EP absent).
@@ -134,6 +143,26 @@ def _dense(p, x):
     return x @ p["W"] + p["b"]
 
 
+def rope_rotate(x, pos, theta: float = 10000.0):
+    """Apply rotary embeddings to (B, T, H, D) at global positions `pos`
+    (shape (T,) int, or a scalar for single-token decode). Pairs dimension
+    halves (d, d + D/2) — the half-split formulation; phases are f32 for
+    long-sequence accuracy, result in x's dtype."""
+    d = x.shape[-1]
+    assert d % 2 == 0, f"rope needs an even head_dim, got {d}"
+    half = d // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    pos = jnp.atleast_1d(jnp.asarray(pos, jnp.float32))
+    ang = pos[:, None] * freqs                               # (T, half)
+    cos = jnp.cos(ang)[None, :, None, :]                     # (1, T, 1, half)
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def _ffn(p, x, cfg: TransformerConfig, h):
     """Post-attention half of a block: FFN (dense GELU or routed MoE) on
     the ln2 output `h`, residual onto `x`. Returns (x, aux)."""
@@ -143,13 +172,14 @@ def _ffn(p, x, cfg: TransformerConfig, h):
     return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h))), 0.0
 
 
-def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False):
+def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
+           pos=None):
     """One pre-LN block; returns (x, aux) where aux is the MoE
     load-balancing loss (0.0 for dense blocks). With `with_kv` also
     returns this block's (k, v) — the decode prefill
     (`models/generate.py`) captures them into its cache; the training
     path never requests them, so XLA dead-code-eliminates the extra
-    outputs there."""
+    outputs there. `pos` (global positions) is required when cfg.rope."""
     b, t, d = x.shape
     h = _layernorm(p["ln1"], x)
     # head-major fused layout (H, 3, D): a contiguous slice of the 3d output
@@ -158,6 +188,10 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False):
     # alignment; see parallel/tensor.py).
     qkv = _dense(p["qkv"], h).reshape(b, t, cfg.n_heads, 3, cfg.head_dim)
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    if cfg.rope:
+        assert pos is not None, "cfg.rope needs positions threaded in"
+        q = rope_rotate(q, pos, cfg.rope_theta)
+        k = rope_rotate(k, pos, cfg.rope_theta)
     a = attn_fn(q, k, v).reshape(b, t, d)
     x = x + _dense(p["proj"], a)
     h = _layernorm(p["ln2"], x)
@@ -188,13 +222,15 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
             f"sequence positions [{pos_offset}, {pos_offset + t}) exceed "
             f"max_seq={cfg.max_seq}")
     pos = pos_offset + jnp.arange(t)
-    x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    x = params["tok_emb"][tokens]
+    if not cfg.rope:  # rope replaces the learned absolute embedding
+        x = x + params["pos_emb"][pos]
     aux_total = 0.0
     block_fn = _block
     if cfg.remat:
-        block_fn = jax.checkpoint(_block, static_argnums=(2, 3))
+        block_fn = jax.checkpoint(_block, static_argnums=(2, 3, 4))
     for blk in params["blocks"]:
-        x, aux = block_fn(blk, x, cfg, attn_fn)
+        x, aux = block_fn(blk, x, cfg, attn_fn, False, pos)
         aux_total = aux_total + aux
     x = _layernorm(params["ln_f"], x)
     return _dense(params["head"], x), aux_total

@@ -185,6 +185,9 @@ class PipelineLMEngine:
             qkv = (h @ blk["qkv"]["W"] + blk["qkv"]["b"]).reshape(
                 b, t, heads_local, 3, hd)
             q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            if cfg.rope:  # sequence is unsharded here: positions 0..t
+                q = T.rope_rotate(q, jnp.arange(t), cfg.rope_theta)
+                k = T.rope_rotate(k, jnp.arange(t), cfg.rope_theta)
             a = attention(q, k, v, causal=True).reshape(
                 b, t, heads_local * hd)
             x = x + psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"]
@@ -216,8 +219,9 @@ class PipelineLMEngine:
                 m = jnp.clip(tk - s, 0, n_mu - 1)
                 active = (tk - s >= 0) & (tk - s < n_mu)
                 tok_m = jax.lax.dynamic_index_in_dim(tokens, m, 0, False)
-                x_own = (params["tok_emb"][tok_m]
-                         + params["pos_emb"][pos])
+                x_own = params["tok_emb"][tok_m]
+                if not cfg.rope:  # rope replaces the learned pos embedding
+                    x_own = x_own + params["pos_emb"][pos]
                 if cfg.compute_dtype is not None:
                     x_own = x_own.astype(cfg.compute_dtype)
                 x_in = jnp.where(is_first, x_own, cur)

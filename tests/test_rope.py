@@ -1,0 +1,145 @@
+"""Rotary position embeddings (`transformer.rope_rotate`, cfg.rope).
+
+The defining property: attention scores depend only on RELATIVE position
+— rotating q at i and k at j gives the same dot product as i+s and j+s.
+That is also exactly why RoPE composes with sequence sharding: each
+device rotates its local block by its global positions, and the
+ring/all-to-all moves already-rotated K.
+"""
+
+from dataclasses import replace
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.models.generate import decode_step, generate, \
+    init_kv_cache, prefill
+from shallowspeed_tpu.optim import Adam, SGD
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                          max_seq=64, rope=True)
+
+
+def toks(seed=0, b=4, t=32, vocab=64):
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+# ------------------------------------------------------------ properties
+
+
+def test_rope_relative_phase_invariance():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 8, 2, 16)), jnp.float32)
+    pos = jnp.arange(8)
+
+    def scores(shift):
+        qr = T.rope_rotate(q, pos + shift)
+        kr = T.rope_rotate(k, pos + shift)
+        return np.asarray(jnp.einsum("bqhd,bkhd->bhqk", qr, kr))
+
+    np.testing.assert_allclose(scores(0), scores(17), rtol=1e-4, atol=1e-4)
+
+
+def test_rope_preserves_norm():
+    """Rotation is orthogonal: vector norms are unchanged."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 2, 16)), jnp.float32)
+    r = T.rope_rotate(x, jnp.arange(8) + 100)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(r), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+
+def test_rope_scalar_position_matches_vector():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 1, 2, 16)), jnp.float32)
+    a = np.asarray(T.rope_rotate(x, 5))
+    b = np.asarray(T.rope_rotate(x, jnp.arange(5, 6)))
+    np.testing.assert_allclose(a, b, atol=0)
+
+
+def test_rope_skips_learned_pos_emb():
+    """With rope on, pos_emb must not influence the logits."""
+    params = T.init(CFG, seed=3)
+    tok, _ = toks(0)
+    base = np.asarray(T.forward(params, tok, CFG))
+    params2 = dict(params, pos_emb=params["pos_emb"] + 100.0)
+    np.testing.assert_allclose(
+        np.asarray(T.forward(params2, tok, CFG)), base, atol=0)
+
+
+# ------------------------------------------- sharded-engine equivalence
+
+
+def serial_engine(opt):
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    return ContextParallelEngine(CFG, opt, mesh, seed=0)
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses", "ulysses-flash"])
+def test_rope_under_sequence_sharding(attn):
+    """sp=4 with rope must match the serial run: each device rotates by
+    its GLOBAL positions (pos_offset), so the moving K is pre-rotated."""
+    ser = serial_engine(SGD(0.1))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "sp"))
+    eng = ContextParallelEngine(CFG, SGD(0.1), mesh, seed=0, attn=attn)
+    for step in range(3):
+        tok, tgt = toks(step)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), (step, attn)
+
+
+def test_rope_under_pipeline():
+    ser = serial_engine(SGD(0.1))
+    cfg = CFG
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "pp"))
+    eng = PipelineLMEngine(cfg, SGD(0.1), mesh, n_mubatches=2, seed=0)
+    for step in range(3):
+        tok, tgt = toks(step, b=8)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), step
+
+
+# ------------------------------------------------------------- decoding
+
+
+def test_rope_cached_decode_matches_forward():
+    params = T.init(CFG, seed=4)
+    tokens, _ = toks(1, b=2, t=10)
+    ref = np.asarray(T.forward(params, tokens, CFG))
+    cache = init_kv_cache(CFG, 2)
+    logits, cache = prefill(params, tokens[:, :1], CFG, cache)
+    np.testing.assert_allclose(np.asarray(logits), ref[:, 0],
+                               rtol=1e-4, atol=1e-5)
+    for pos in range(1, tokens.shape[1]):
+        logits, cache = decode_step(params, jnp.asarray(tokens[:, pos]),
+                                    pos, cache, CFG)
+        np.testing.assert_allclose(np.asarray(logits), ref[:, pos],
+                                   rtol=1e-4, atol=1e-5, err_msg=str(pos))
+
+
+def test_rope_generation_runs():
+    params = T.init(CFG, seed=5)
+    prompt, _ = toks(2, b=2, t=4)
+    out = np.asarray(generate(params, prompt, CFG, 8, temperature=0.0))
+    assert out.shape == (2, 8)
+    assert (out >= 0).all() and (out < CFG.vocab).all()
+
+
+def test_rope_trains():
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("dp", "sp"))
+    cfg = replace(CFG, compute_dtype=jnp.bfloat16)
+    eng = ContextParallelEngine(cfg, Adam(5e-3), mesh, seed=0)
+    tok, tgt = toks(7)
+    losses = [eng.train_batch(tok, tgt) for _ in range(20)]
+    assert losses[-1] < losses[0] - 0.15, losses[::5]

@@ -65,6 +65,10 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true",
                    help="mixed precision: bfloat16 compute (MXU-native), "
                         "float32 master weights/optimizer state")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings (replaces the learned "
+                        "absolute embedding; composes with every engine "
+                        "and sequence sharding)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize each block's activations in the "
                         "backward (jax.checkpoint): ~1 extra forward of "
@@ -201,7 +205,7 @@ def train(args) -> float:
                             max_seq=args.seq_len, n_experts=args.experts,
                             moe_top_k=args.moe_top_k,
                             compute_dtype=jnp.bfloat16 if args.bf16 else None,
-                            remat=args.remat)
+                            remat=args.remat, rope=args.rope)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
