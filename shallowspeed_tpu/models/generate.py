@@ -60,7 +60,7 @@ def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
     """One block on a single-token slice x (B, 1, d); writes this token's
     K/V at `pos` and attends over the cache. Returns (x, cache_blk)."""
     b = x.shape[0]
-    h = T._layernorm(p["ln1"], x)
+    h = T._norm(p["ln1"], x, cfg)
     qkv = T._dense(p["qkv"], h).reshape(b, 1, cfg.n_heads, 3, cfg.head_dim)
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
     if cfg.rope:  # rotate at this token's position; cache stores rotated K
@@ -74,7 +74,7 @@ def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
     }
     a = _cached_attention(q, cache_blk, pos).reshape(b, 1, cfg.d_model)
     x = x + T._dense(p["proj"], a)
-    h = T._layernorm(p["ln2"], x)
+    h = T._norm(p["ln2"], x, cfg)
     x, _aux = T._ffn(p, x, cfg, h)
     return x, cache_blk
 
@@ -109,7 +109,7 @@ def prefill(params, tokens, cfg: T.TransformerConfig, cache):
             "v": jax.lax.dynamic_update_slice_in_dim(
                 cache[i]["v"], v.astype(cache[i]["v"].dtype), 0, axis=1),
         }
-    x = T._layernorm(params["ln_f"], x)
+    x = T._norm(params["ln_f"], x, cfg)
     logits = T._dense(params["head"], x[:, tp - 1])
     return logits.astype(jnp.float32), cache
 
@@ -126,7 +126,7 @@ def decode_step(params, token, pos, cache, cfg: T.TransformerConfig):
     for blk, cblk in zip(params["blocks"], cache):
         x, cblk = _block_decode(blk, x, cfg, cblk, pos)
         new_cache.append(cblk)
-    x = T._layernorm(params["ln_f"], x)
+    x = T._norm(params["ln_f"], x, cfg)
     logits = T._dense(params["head"], x[:, 0])
     return logits.astype(jnp.float32), new_cache
 

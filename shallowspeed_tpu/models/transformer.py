@@ -62,6 +62,12 @@ class TransformerConfig:
     # the ring/all-to-all ever moves K.
     rope: bool = False
     rope_theta: float = 10000.0
+    # Block options: normalization ("layernorm" | "rmsnorm") and dense FFN
+    # flavor ("gelu" | "swiglu"). SwiGLU adds a "gate" projection per block
+    # (column-sharded like "up" under tensor parallelism); MoE configs
+    # (n_experts > 0) replace the dense FFN entirely and ignore `ffn`.
+    norm: str = "layernorm"
+    ffn: str = "gelu"
     # Mixture-of-experts (0 = dense FFN everywhere). With n_experts > 0 every
     # block's FFN becomes a top-k routed MoE (`ops/moe.py`) — the family the
     # reference lacks entirely (SURVEY §2: EP absent).
@@ -69,6 +75,10 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 1e-2
+
+    def __post_init__(self):
+        assert self.norm in ("layernorm", "rmsnorm"), self.norm
+        assert self.ffn in ("gelu", "swiglu"), self.ffn
 
     @property
     def head_dim(self) -> int:
@@ -95,6 +105,8 @@ def init(cfg: TransformerConfig, seed: int = 0):
             "proj": _dense_init(rng, d, d, dt),
             "ln2": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
         }
+        if cfg.ffn == "swiglu" and cfg.n_experts == 0:
+            blk["gate"] = _dense_init(rng, d, 4 * d, dt)
         if cfg.n_experts > 0:
             e, ff = cfg.n_experts, 4 * d
             blk["moe"] = {
@@ -139,6 +151,20 @@ def _layernorm(p, x, eps=1e-5):
     return y.astype(x.dtype)
 
 
+def _rmsnorm(p, x, eps=1e-5):
+    """RMSNorm (Zhang & Sennrich): scale by the root-mean-square only —
+    no centering, no bias (p["b"] is kept in the pytree for structural
+    stability but unused). f32 statistics like `_layernorm`."""
+    xf = x.astype(jnp.float32)
+    ms = (xf * xf).mean(axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(ms + eps) * p["g"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _norm(p, x, cfg: TransformerConfig):
+    return (_rmsnorm if cfg.norm == "rmsnorm" else _layernorm)(p, x)
+
+
 def _dense(p, x):
     return x @ p["W"] + p["b"]
 
@@ -164,11 +190,14 @@ def rope_rotate(x, pos, theta: float = 10000.0):
 
 
 def _ffn(p, x, cfg: TransformerConfig, h):
-    """Post-attention half of a block: FFN (dense GELU or routed MoE) on
-    the ln2 output `h`, residual onto `x`. Returns (x, aux)."""
+    """Post-attention half of a block: FFN (dense GELU, SwiGLU, or routed
+    MoE) on the norm output `h`, residual onto `x`. Returns (x, aux)."""
     if "moe" in p:
         y, aux = moe_ffn(p["moe"], h, cfg.moe_top_k, cfg.moe_capacity_factor)
         return x + y, aux
+    if "gate" in p:  # SwiGLU: silu(gate) * up, both column-parallel
+        u = jax.nn.silu(_dense(p["gate"], h)) * _dense(p["up"], h)
+        return x + _dense(p["down"], u), 0.0
     return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h))), 0.0
 
 
@@ -181,7 +210,7 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
     path never requests them, so XLA dead-code-eliminates the extra
     outputs there. `pos` (global positions) is required when cfg.rope."""
     b, t, d = x.shape
-    h = _layernorm(p["ln1"], x)
+    h = _norm(p["ln1"], x, cfg)
     # head-major fused layout (H, 3, D): a contiguous slice of the 3d output
     # dim is a whole group of heads, so tensor-parallel column sharding of
     # qkv["W"] keeps attention fully local to each device (Megatron
@@ -194,7 +223,7 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
         k = rope_rotate(k, pos, cfg.rope_theta)
     a = attn_fn(q, k, v).reshape(b, t, d)
     x = x + _dense(p["proj"], a)
-    h = _layernorm(p["ln2"], x)
+    h = _norm(p["ln2"], x, cfg)
     x, aux = _ffn(p, x, cfg, h)
     if with_kv:
         return x, aux, (k, v)
@@ -232,7 +261,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     for blk in params["blocks"]:
         x, aux = block_fn(blk, x, cfg, attn_fn, False, pos)
         aux_total = aux_total + aux
-    x = _layernorm(params["ln_f"], x)
+    x = _norm(params["ln_f"], x, cfg)
     return _dense(params["head"], x), aux_total
 
 

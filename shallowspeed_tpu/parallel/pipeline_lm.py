@@ -128,6 +128,8 @@ class PipelineLMEngine:
             ln = {"g": P("pp"), "b": P("pp")}
             blocks_spec = {"ln1": ln, "qkv": col, "proj": rowp,
                            "ln2": ln, "up": col, "down": rowp}
+            if cfg.ffn == "swiglu":
+                blocks_spec = {**blocks_spec, "gate": col}
         else:
             blocks_spec = tree_map(lambda _: P("pp"), host["blocks"])
         self._pspecs = {
@@ -181,7 +183,7 @@ class PipelineLMEngine:
             pair, Megatron placement). With tp absent this is exactly
             `T._block`'s dense path."""
             b, t, d = x.shape
-            h = T._layernorm(blk["ln1"], x)
+            h = T._norm(blk["ln1"], x, cfg)
             qkv = (h @ blk["qkv"]["W"] + blk["qkv"]["b"]).reshape(
                 b, t, heads_local, 3, hd)
             q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
@@ -191,8 +193,14 @@ class PipelineLMEngine:
             a = attention(q, k, v, causal=True).reshape(
                 b, t, heads_local * hd)
             x = x + psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"]
-            h = T._layernorm(blk["ln2"], x)
-            u = jax.nn.gelu(h @ blk["up"]["W"] + blk["up"]["b"])
+            h = T._norm(blk["ln2"], x, cfg)
+            if cfg.ffn == "swiglu":
+                # gate/up share the same column partition, so the
+                # elementwise product is local to each tp shard
+                u = (jax.nn.silu(h @ blk["gate"]["W"] + blk["gate"]["b"])
+                     * (h @ blk["up"]["W"] + blk["up"]["b"]))
+            else:
+                u = jax.nn.gelu(h @ blk["up"]["W"] + blk["up"]["b"])
             return x + psum_tp(u @ blk["down"]["W"]) + blk["down"]["b"]
 
         def apply_blocks(blocks, x):
@@ -227,7 +235,7 @@ class PipelineLMEngine:
                 x_in = jnp.where(is_first, x_own, cur)
                 h = apply_blocks(params["blocks"], x_in)
                 # last stage: this microbatch's mean token NLL
-                hf = T._layernorm(params["ln_f"], h)
+                hf = T._norm(params["ln_f"], h, cfg)
                 logits = T._dense(params["head"], hf).astype(jnp.float32)
                 tgt_m = jax.lax.dynamic_index_in_dim(targets, m, 0, False)
                 logp = jax.nn.log_softmax(logits, axis=-1)

@@ -40,6 +40,10 @@ def param_specs(cfg: T.TransformerConfig) -> dict:
     ln = {"g": P(), "b": P()}
     block = {"ln1": ln, "qkv": col, "proj": row,
              "ln2": ln, "up": col, "down": row}
+    if cfg.ffn == "swiglu" and cfg.n_experts == 0:
+        # SwiGLU's gate is column-parallel like up: the elementwise
+        # silu(gate) * up then stays local to each tp shard
+        block = {**block, "gate": col}
     return {
         "tok_emb": P(),
         "pos_emb": P(),

@@ -1,0 +1,142 @@
+"""Block options: RMSNorm and SwiGLU (`TransformerConfig.norm/.ffn`),
+threaded through every engine's placement and the decode path.
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.models.generate import generate
+from shallowspeed_tpu.optim import Adam, SGD
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
+
+BASE = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                           max_seq=32)
+MODERN = replace(BASE, norm="rmsnorm", ffn="swiglu", rope=True)
+
+
+def toks(seed=0, b=4, t=32, vocab=64):
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+# ------------------------------------------------------------ unit level
+
+
+def test_rmsnorm_properties():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 32)) * 5 + 3, jnp.float32)
+    p = {"g": jnp.ones((32,)), "b": jnp.zeros((32,))}
+    y = np.asarray(T._rmsnorm(p, x))
+    # unit RMS rows, no centering (mean generally nonzero)
+    rms = np.sqrt((y ** 2).mean(axis=-1))
+    np.testing.assert_allclose(rms, 1.0, rtol=1e-4)
+    assert abs(y.mean()) > 0.01  # differs from layernorm's zero mean
+    # bias must be inert (kept only for structural stability)
+    y2 = np.asarray(T._rmsnorm({"g": p["g"], "b": p["b"] + 7.0}, x))
+    np.testing.assert_allclose(y2, y, atol=0)
+
+
+def test_swiglu_structure_and_forward():
+    cfg = replace(BASE, ffn="swiglu")
+    params = T.init(cfg, seed=1)
+    assert "gate" in params["blocks"][0]
+    assert "gate" not in T.init(BASE, seed=1)["blocks"][0]
+    tok, tgt = toks(0)
+    assert np.isfinite(float(T.loss(params, tok, tgt, cfg)))
+    # swiglu output differs from gelu on the same seed
+    lg = np.asarray(T.forward(params, tok, cfg))
+    lg_gelu = np.asarray(T.forward(T.init(BASE, seed=1), tok, BASE))
+    assert not np.allclose(lg, lg_gelu)
+
+
+def test_moe_ignores_ffn_flag():
+    cfg = replace(BASE, ffn="swiglu", n_experts=4)
+    params = T.init(cfg, seed=1)
+    assert "gate" not in params["blocks"][0]  # moe has its own router gate
+    assert "moe" in params["blocks"][0]
+
+
+# --------------------------------------------------- engine equivalence
+
+
+def serial_engine(cfg, opt):
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    return ContextParallelEngine(cfg, opt, mesh, seed=0)
+
+
+def test_modern_block_under_sequence_sharding():
+    ser = serial_engine(MODERN, SGD(0.1))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    eng = ContextParallelEngine(MODERN, SGD(0.1), mesh, seed=0)
+    for step in range(3):
+        tok, tgt = toks(step)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), step
+
+
+def test_modern_block_under_tensor_parallel():
+    ser = serial_engine(MODERN, SGD(0.1))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    eng = TensorParallelEngine(MODERN, SGD(0.1), mesh, seed=0)
+    gate = eng.params["blocks"][0]["gate"]["W"]
+    assert "tp" in gate.sharding.spec  # column-parallel gate
+    for step in range(3):
+        tok, tgt = toks(step)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), step
+
+
+@pytest.mark.parametrize("mesh_shape,axes", [((2, 2), ("dp", "pp")),
+                                             ((1, 2, 2), ("dp", "pp", "tp"))])
+def test_modern_block_under_pipeline(mesh_shape, axes):
+    ser = serial_engine(MODERN, SGD(0.1))
+    devs = np.array(jax.devices()[: int(np.prod(mesh_shape))]).reshape(
+        mesh_shape)
+    eng = PipelineLMEngine(MODERN, SGD(0.1), Mesh(devs, axes),
+                           n_mubatches=2, seed=0)
+    for step in range(3):
+        tok, tgt = toks(step, b=8)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), (step, axes)
+
+
+# ------------------------------------------------------------- decoding
+
+
+def test_modern_block_cached_decode():
+    from shallowspeed_tpu.models.generate import decode_step, init_kv_cache, \
+        prefill
+
+    params = T.init(MODERN, seed=4)
+    tokens, _ = toks(1, b=2, t=10)
+    ref = np.asarray(T.forward(params, tokens, MODERN))
+    cache = init_kv_cache(MODERN, 2)
+    logits, cache = prefill(params, tokens[:, :1], MODERN, cache)
+    np.testing.assert_allclose(np.asarray(logits), ref[:, 0],
+                               rtol=1e-4, atol=1e-5)
+    for pos in range(1, tokens.shape[1]):
+        logits, cache = decode_step(params, jnp.asarray(tokens[:, pos]),
+                                    pos, cache, MODERN)
+        np.testing.assert_allclose(np.asarray(logits), ref[:, pos],
+                                   rtol=1e-4, atol=1e-5, err_msg=str(pos))
+
+
+def test_modern_block_trains_bf16():
+    cfg = replace(MODERN, compute_dtype=jnp.bfloat16)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("dp", "sp"))
+    eng = ContextParallelEngine(cfg, Adam(5e-3), mesh, seed=0)
+    tok, tgt = toks(7)
+    losses = [eng.train_batch(tok, tgt) for _ in range(20)]
+    assert losses[-1] < losses[0] - 0.15, losses[::5]
+    out = np.asarray(generate(eng.params, tok[:1, :4], cfg, 8,
+                              temperature=0.0))
+    assert out.shape == (1, 8)

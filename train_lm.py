@@ -65,6 +65,10 @@ def parse_args(argv=None):
     p.add_argument("--bf16", action="store_true",
                    help="mixed precision: bfloat16 compute (MXU-native), "
                         "float32 master weights/optimizer state")
+    p.add_argument("--norm", default="layernorm",
+                   choices=["layernorm", "rmsnorm"])
+    p.add_argument("--ffn", default="gelu", choices=["gelu", "swiglu"],
+                   help="dense FFN flavor (ignored with --experts)")
     p.add_argument("--rope", action="store_true",
                    help="rotary position embeddings (replaces the learned "
                         "absolute embedding; composes with every engine "
@@ -205,7 +209,8 @@ def train(args) -> float:
                             max_seq=args.seq_len, n_experts=args.experts,
                             moe_top_k=args.moe_top_k,
                             compute_dtype=jnp.bfloat16 if args.bf16 else None,
-                            remat=args.remat, rope=args.rope)
+                            remat=args.remat, rope=args.rope,
+                            norm=args.norm, ffn=args.ffn)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
