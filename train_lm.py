@@ -102,6 +102,12 @@ def parse_args(argv=None):
                         "model (KV-cache decode) and print them")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--prompt", type=str, default="",
+                   help="UTF-8 prompt for --generate (byte-level; default: "
+                        "a 16-token prefix from the data stream)")
+    p.add_argument("--sample-only", action="store_true",
+                   help="skip training: restore --save-dir's latest "
+                        "checkpoint (implies --resume) and just --generate")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--prefetch", type=int, default=2,
@@ -154,9 +160,13 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
 
-    if args.generate and args.generate + 16 > args.seq_len:
-        raise SystemExit(f"--generate {args.generate} + the 16-token prompt "
-                         f"exceeds --seq-len {args.seq_len} (= max_seq)")
+    if (args.prompt or args.sample_only) and not args.generate:
+        args.generate = 128  # --prompt/--sample-only imply sampling
+    prompt_len = len(args.prompt.encode()) if args.prompt else 16
+    if args.generate and args.generate + prompt_len > args.seq_len:
+        raise SystemExit(f"--generate {args.generate} + the {prompt_len}-"
+                         f"token prompt exceeds --seq-len {args.seq_len} "
+                         f"(= max_seq)")
     composite = args.sp > 1 and args.tp > 1
     if args.pp > 1 and (args.sp > 1 or args.ep > 1 or args.experts
                         or args.fsdp or args.zero1):
@@ -264,16 +274,16 @@ def train(args) -> float:
                                        attn=args.attn, zero1=args.zero1)
 
     start_step = 0
-    if args.resume:
+    if args.resume or args.sample_only:
         if not args.save_dir:
-            raise SystemExit("--resume requires --save-dir")
+            raise SystemExit("--resume/--sample-only require --save-dir")
         ck = checkpoint.latest(args.save_dir)
         if ck is None:
             raise SystemExit(f"--resume: no checkpoint under {args.save_dir!r}")
         start_step = checkpoint.restore(engine, ck)
         rprint(f"resumed from {ck} at step {start_step}")
 
-    if start_step >= args.steps:
+    if not args.sample_only and start_step >= args.steps:
         raise SystemExit(
             f"checkpoint is already at step {start_step} >= --steps "
             f"{args.steps}; nothing to do")
@@ -308,6 +318,10 @@ def train(args) -> float:
             **{**vars(args), "seed": args.seed + 1})
         tok, tgt = make_batch(val_args, vocab, 10**9 + n_evals, val_data)
         return float(engine.eval_loss(local_rows(tok), local_rows(tgt)))
+
+    if args.sample_only:
+        sample_and_print(args, engine, cfg, vocab, text_data)
+        return float("nan")
 
     t0 = time.time()
     val_time = 0.0  # excluded from tok/s (val syncs + compiles once)
@@ -387,18 +401,30 @@ def train(args) -> float:
             placed.close()
 
     if args.generate > 0:
-        from shallowspeed_tpu.models.generate import generate
+        sample_and_print(args, engine, cfg, vocab, text_data)
+    return loss
 
+
+def sample_and_print(args, engine, cfg, vocab, text_data):
+    """KV-cache decode from the trained/restored model: --prompt bytes or
+    a 16-token prefix from the data stream."""
+    from shallowspeed_tpu.models.generate import generate
+    from shallowspeed_tpu.utils import rprint
+
+    # length already validated fail-fast at argument-checking time
+    # (--prompt/--sample-only force args.generate to be set there)
+    if args.prompt:
+        prompt = np.frombuffer(args.prompt.encode(), np.uint8).astype(
+            np.int32)[None, :]
+    else:
         prompt, _ = make_batch(args, vocab, 0, text_data)
         prompt = prompt[:1, :16]  # one row, short prefix
-        out = np.asarray(generate(
-            engine.get_canonical_params(), prompt, cfg, args.generate,
-            temperature=args.temperature, top_k=args.top_k,
-            seed=args.seed))
-        body = bytes(int(x) for x in out[0])
-        rprint(f"prompt: {bytes(int(x) for x in prompt[0])!r}")
-        rprint(f"sample: {body!r}")
-    return loss
+    out = np.asarray(generate(
+        engine.get_canonical_params(), prompt, cfg, args.generate,
+        temperature=args.temperature, top_k=args.top_k, seed=args.seed))
+    body = bytes(int(x) for x in out[0])
+    rprint(f"prompt: {bytes(int(x) for x in prompt[0])!r}")
+    rprint(f"sample: {body!r}")
 
 
 if __name__ == "__main__":
