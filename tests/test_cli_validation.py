@@ -1,0 +1,58 @@
+"""train_lm.py argument-validation matrix — every rejected combination
+must fail fast with a labeled SystemExit in train()'s validation block,
+BEFORE any engine is built or parameters are placed on devices.
+"""
+
+import pytest
+
+from train_lm import parse_args, train
+
+
+def expect_exit(argv, match):
+    with pytest.raises(SystemExit, match=match):
+        train(parse_args(argv))
+
+
+def test_pp_excludes_fsdp_zero1_sp_ep():
+    for extra in (["--fsdp"], ["--zero1"], ["--sp", "2"],
+                  ["--ep", "2", "--experts", "2"]):
+        expect_exit(["--pp", "2"] + extra, "--pp composes with --dp and "
+                                           "--tp only")
+
+
+def test_ep_requires_experts():
+    expect_exit(["--ep", "2"], "--ep requires --experts")
+
+
+def test_ep_excludes_sp_tp():
+    expect_exit(["--ep", "2", "--experts", "2", "--sp", "2"],
+                "--ep composes with --dp only")
+
+
+def test_fsdp_excludes_ep_and_zero1():
+    expect_exit(["--fsdp", "--zero1"], "--fsdp composes with")
+    expect_exit(["--fsdp", "--ep", "2", "--experts", "2"],
+                "--fsdp composes with")
+
+
+def test_attn_guards():
+    expect_exit(["--tp", "2", "--attn", "flash"], "not available with")
+    expect_exit(["--fsdp", "--attn", "ulysses"], "not available with")
+    expect_exit(["--pp", "2", "--attn", "flash"], "not available with --pp")
+
+
+def test_generate_overflow_fails_at_parse_time():
+    expect_exit(["--generate", "120", "--seq-len", "128"],
+                "exceeds --seq-len")
+    # --prompt implies generation (default 128) and counts its own bytes
+    expect_exit(["--prompt", "x" * 40, "--seq-len", "128"],
+                "40-token prompt exceeds")
+
+
+def test_sample_only_requires_save_dir():
+    expect_exit(["--sample-only", "--seq-len", "512"],
+                "require --save-dir")
+
+
+def test_resume_requires_save_dir():
+    expect_exit(["--resume"], "require --save-dir")

@@ -12,20 +12,14 @@ both it and the jitted `FusedDPEngine` from the SAME seeded init on the
 same batches and require the weights to stay together.
 """
 
-import os
-import sys
-
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+from bench import GBS, LAYER_SIZES, LR, N_MU, numpy_baseline_step_fn
 
-from bench import GBS, LAYER_SIZES, LR, N_MU, numpy_baseline_step_fn  # noqa: E402
-
-from shallowspeed_tpu.engine import FusedDPEngine  # noqa: E402
-from shallowspeed_tpu.models.mlp import MLPStage  # noqa: E402
-from shallowspeed_tpu.optim import SGD  # noqa: E402
-from shallowspeed_tpu.parallel.mesh import make_mesh  # noqa: E402
+from shallowspeed_tpu.engine import FusedDPEngine
+from shallowspeed_tpu.models.mlp import MLPStage
+from shallowspeed_tpu.optim import SGD
+from shallowspeed_tpu.parallel.mesh import make_mesh
 
 
 def make_data(seed, n_batches):

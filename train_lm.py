@@ -160,6 +160,8 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
 
+    if (args.resume or args.sample_only) and not args.save_dir:
+        raise SystemExit("--resume/--sample-only require --save-dir")
     if (args.prompt or args.sample_only) and not args.generate:
         args.generate = 128  # --prompt/--sample-only imply sampling
     prompt_len = len(args.prompt.encode()) if args.prompt else 16
@@ -274,9 +276,7 @@ def train(args) -> float:
                                        attn=args.attn, zero1=args.zero1)
 
     start_step = 0
-    if args.resume or args.sample_only:
-        if not args.save_dir:
-            raise SystemExit("--resume/--sample-only require --save-dir")
+    if args.resume or args.sample_only:  # save-dir presence checked early
         ck = checkpoint.latest(args.save_dir)
         if ck is None:
             raise SystemExit(f"--resume: no checkpoint under {args.save_dir!r}")
