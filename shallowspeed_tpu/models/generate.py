@@ -31,20 +31,23 @@ from shallowspeed_tpu.models import transformer as T
 
 
 def init_kv_cache(cfg: T.TransformerConfig, batch: int):
-    """Per-block K/V buffers (B, max_seq, H, head_dim), zero-filled."""
+    """Per-block K/V buffers (B, max_seq, Hkv, head_dim), zero-filled —
+    under GQA the cache holds the UNREPEATED kv heads, shrinking its
+    memory by the query-group factor."""
     dt = cfg.compute_dtype or cfg.dtype
-    shape = (batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    shape = (batch, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
     return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
             for _ in range(cfg.n_layers)]
 
 
-def _cached_attention(q, cache_blk, pos):
+def _cached_attention(q, cache_blk, pos, cfg):
     """q: (B, 1, H, hd) at position `pos`; attends over cache[:, :pos+1].
 
     The cache tail beyond `pos` is zeros — masked out by position, so its
-    contents never matter.
+    contents never matter. GQA caches hold Hkv heads; repeat at use.
     """
-    k, v = cache_blk["k"], cache_blk["v"]
+    k = T.repeat_kv(cache_blk["k"], cfg)
+    v = T.repeat_kv(cache_blk["v"], cfg)
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -61,8 +64,7 @@ def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
     K/V at `pos` and attends over the cache. Returns (x, cache_blk)."""
     b = x.shape[0]
     h = T._norm(p["ln1"], x, cfg)
-    qkv = T._dense(p["qkv"], h).reshape(b, 1, cfg.n_heads, 3, cfg.head_dim)
-    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    q, k, v = T._qkv(p, h, cfg)
     if cfg.rope:  # rotate at this token's position; cache stores rotated K
         q = T.rope_rotate(q, pos, cfg.rope_theta)
         k = T.rope_rotate(k, pos, cfg.rope_theta)
@@ -72,7 +74,7 @@ def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
         "v": jax.lax.dynamic_update_slice_in_dim(
             cache_blk["v"], v.astype(cache_blk["v"].dtype), pos, axis=1),
     }
-    a = _cached_attention(q, cache_blk, pos).reshape(b, 1, cfg.d_model)
+    a = _cached_attention(q, cache_blk, pos, cfg).reshape(b, 1, cfg.d_model)
     x = x + T._dense(p["proj"], a)
     h = T._norm(p["ln2"], x, cfg)
     x, _aux = T._ffn(p, x, cfg, h)

@@ -68,6 +68,14 @@ class TransformerConfig:
     # (n_experts > 0) replace the dense FFN entirely and ignore `ffn`.
     norm: str = "layernorm"
     ffn: str = "gelu"
+    # Grouped-query attention (Ainslie et al., GQA): n_kv_heads < n_heads
+    # K/V heads, each shared by a group of n_heads/n_kv_heads query heads.
+    # 0 = plain multi-head attention (the fused qkv projection). With GQA
+    # the projection splits into "q" and "kv" params; K/V are repeated to
+    # the full head count right before the attention op (so every
+    # attention substrate works unchanged), but the decode KV cache stores
+    # the UNREPEATED heads — its memory shrinks by the group factor.
+    n_kv_heads: int = 0
     # Mixture-of-experts (0 = dense FFN everywhere). With n_experts > 0 every
     # block's FFN becomes a top-k routed MoE (`ops/moe.py`) — the family the
     # reference lacks entirely (SURVEY §2: EP absent).
@@ -79,11 +87,24 @@ class TransformerConfig:
     def __post_init__(self):
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
         assert self.ffn in ("gelu", "swiglu"), self.ffn
+        assert self.n_kv_heads >= 0, (
+            f"n_kv_heads must be non-negative, got {self.n_kv_heads}")
+        assert self.n_heads % self.kv_heads == 0, (
+            f"n_heads={self.n_heads} must be divisible by "
+            f"n_kv_heads={self.kv_heads}")
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def gqa(self) -> bool:
+        return self.kv_heads != self.n_heads
 
 
 def _dense_init(rng, in_d, out_d, dtype):
@@ -101,10 +122,15 @@ def init(cfg: TransformerConfig, seed: int = 0):
     for _ in range(cfg.n_layers):
         blk = {
             "ln1": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
-            "qkv": _dense_init(rng, d, 3 * d, dt),
             "proj": _dense_init(rng, d, d, dt),
             "ln2": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
         }
+        if cfg.gqa:  # separate q and (smaller) fused kv projections
+            blk["q"] = _dense_init(rng, d, d, dt)
+            blk["kv"] = _dense_init(
+                rng, d, 2 * cfg.kv_heads * cfg.head_dim, dt)
+        else:
+            blk["qkv"] = _dense_init(rng, d, 3 * d, dt)
         if cfg.ffn == "swiglu" and cfg.n_experts == 0:
             blk["gate"] = _dense_init(rng, d, 4 * d, dt)
         if cfg.n_experts > 0:
@@ -189,6 +215,27 @@ def rope_rotate(x, pos, theta: float = 10000.0):
     return out.astype(x.dtype)
 
 
+def _qkv(p, h, cfg: TransformerConfig):
+    """(q (B,T,H,hd), k, v (B,T,Hkv,hd)) from the block's projection(s):
+    the fused head-major qkv, or split q / fused kv under GQA."""
+    b, t, _ = h.shape
+    if "kv" in p:
+        q = _dense(p["q"], h).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        kv = _dense(p["kv"], h).reshape(b, t, cfg.kv_heads, 2, cfg.head_dim)
+        k, v = kv[..., 0, :], kv[..., 1, :]
+    else:
+        qkv = _dense(p["qkv"], h).reshape(b, t, cfg.n_heads, 3,
+                                          cfg.head_dim)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    return q, k, v
+
+
+def repeat_kv(x, cfg: TransformerConfig):
+    """Broadcast K/V heads to the full query-head count (no-op for MHA)."""
+    g = cfg.n_heads // cfg.kv_heads
+    return x if g == 1 else jnp.repeat(x, g, axis=2)
+
+
 def _ffn(p, x, cfg: TransformerConfig, h):
     """Post-attention half of a block: FFN (dense GELU, SwiGLU, or routed
     MoE) on the norm output `h`, residual onto `x`. Returns (x, aux)."""
@@ -214,19 +261,20 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
     # head-major fused layout (H, 3, D): a contiguous slice of the 3d output
     # dim is a whole group of heads, so tensor-parallel column sharding of
     # qkv["W"] keeps attention fully local to each device (Megatron
-    # alignment; see parallel/tensor.py).
-    qkv = _dense(p["qkv"], h).reshape(b, t, cfg.n_heads, 3, cfg.head_dim)
-    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    # alignment; see parallel/tensor.py). Under GQA, _qkv splits into
+    # q / kv projections instead.
+    q, k, v = _qkv(p, h, cfg)
     if cfg.rope:
         assert pos is not None, "cfg.rope needs positions threaded in"
         q = rope_rotate(q, pos, cfg.rope_theta)
         k = rope_rotate(k, pos, cfg.rope_theta)
-    a = attn_fn(q, k, v).reshape(b, t, d)
+    kv_cacheable = (k, v)  # rotated, UNREPEATED — the decode cache layout
+    a = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, t, d)
     x = x + _dense(p["proj"], a)
     h = _norm(p["ln2"], x, cfg)
     x, aux = _ffn(p, x, cfg, h)
     if with_kv:
-        return x, aux, (k, v)
+        return x, aux, kv_cacheable
     return x, aux
 
 

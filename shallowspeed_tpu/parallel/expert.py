@@ -33,7 +33,9 @@ def param_specs(cfg: T.TransformerConfig) -> dict:
     ln = {"g": P(), "b": P()}
     moe = {"gate": P(), "wi": P("ep", None, None), "bi": P("ep", None),
            "wo": P("ep", None, None), "bo": P("ep", None)}
-    block = {"ln1": ln, "qkv": dense, "proj": dense, "ln2": ln, "moe": moe}
+    attn_proj = ({"q": dense, "kv": dense} if cfg.gqa
+                 else {"qkv": dense})
+    block = {"ln1": ln, **attn_proj, "proj": dense, "ln2": ln, "moe": moe}
     return {
         "tok_emb": P(),
         "pos_emb": P(),

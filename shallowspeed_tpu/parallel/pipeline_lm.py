@@ -110,6 +110,8 @@ class PipelineLMEngine:
             f"n_layers={cfg.n_layers} must be divisible by pp={self.pp}")
         assert cfg.n_heads % self.tp == 0, (
             f"n_heads={cfg.n_heads} must be divisible by tp={self.tp}")
+        assert cfg.kv_heads % self.tp == 0, (
+            f"n_kv_heads={cfg.kv_heads} must be divisible by tp={self.tp}")
         assert (4 * cfg.d_model) % self.tp == 0
         self.n_mu = n_mubatches
         self.optimizer = optimizer
@@ -126,7 +128,9 @@ class PipelineLMEngine:
             col = {"W": P("pp", None, "tp"), "b": P("pp", "tp")}
             rowp = {"W": P("pp", "tp", None), "b": P("pp")}
             ln = {"g": P("pp"), "b": P("pp")}
-            blocks_spec = {"ln1": ln, "qkv": col, "proj": rowp,
+            attn_proj = ({"q": col, "kv": col} if cfg.gqa
+                         else {"qkv": col})
+            blocks_spec = {"ln1": ln, **attn_proj, "proj": rowp,
                            "ln2": ln, "up": col, "down": rowp}
             if cfg.ffn == "swiglu":
                 blocks_spec = {**blocks_spec, "gate": col}
@@ -167,6 +171,7 @@ class PipelineLMEngine:
         opt.clip_axes = ("pp", "tp") if self.has_tp else ("pp",)
         right = [(i, (i + 1) % pp) for i in range(pp)]
         heads_local = cfg.n_heads // self.tp
+        kv_local = cfg.kv_heads // self.tp
         hd = cfg.head_dim
 
         if self.has_tp:
@@ -184,12 +189,22 @@ class PipelineLMEngine:
             `T._block`'s dense path."""
             b, t, d = x.shape
             h = T._norm(blk["ln1"], x, cfg)
-            qkv = (h @ blk["qkv"]["W"] + blk["qkv"]["b"]).reshape(
-                b, t, heads_local, 3, hd)
-            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+            if cfg.gqa:  # split projections; each shard owns whole groups
+                q = (h @ blk["q"]["W"] + blk["q"]["b"]).reshape(
+                    b, t, heads_local, hd)
+                kv = (h @ blk["kv"]["W"] + blk["kv"]["b"]).reshape(
+                    b, t, kv_local, 2, hd)
+                k, v = kv[..., 0, :], kv[..., 1, :]
+            else:
+                qkv = (h @ blk["qkv"]["W"] + blk["qkv"]["b"]).reshape(
+                    b, t, heads_local, 3, hd)
+                q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
             if cfg.rope:  # sequence is unsharded here: positions 0..t
                 q = T.rope_rotate(q, jnp.arange(t), cfg.rope_theta)
                 k = T.rope_rotate(k, jnp.arange(t), cfg.rope_theta)
+            # group factor is tp-invariant (both head counts divide by tp)
+            k = T.repeat_kv(k, cfg)
+            v = T.repeat_kv(v, cfg)
             a = attention(q, k, v, causal=True).reshape(
                 b, t, heads_local * hd)
             x = x + psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"]

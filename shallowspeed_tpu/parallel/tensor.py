@@ -38,7 +38,10 @@ def param_specs(cfg: T.TransformerConfig) -> dict:
     col = {"W": P(None, "tp"), "b": P("tp")}
     row = {"W": P("tp", None), "b": P()}
     ln = {"g": P(), "b": P()}
-    block = {"ln1": ln, "qkv": col, "proj": row,
+    # GQA splits the attention projection: q and kv both column-sharded
+    # (whole head groups per shard; needs kv_heads % tp == 0 too)
+    attn_proj = {"q": col, "kv": col} if cfg.gqa else {"qkv": col}
+    block = {"ln1": ln, **attn_proj, "proj": row,
              "ln2": ln, "up": col, "down": row}
     if cfg.ffn == "swiglu" and cfg.n_experts == 0:
         # SwiGLU's gate is column-parallel like up: the elementwise
@@ -61,6 +64,8 @@ class TensorParallelEngine(GSPMDEngine):
         self.tp = mesh.devices.shape[1]
         assert cfg.n_heads % self.tp == 0, (
             f"n_heads={cfg.n_heads} must be divisible by tp={self.tp}")
+        assert cfg.kv_heads % self.tp == 0, (
+            f"n_kv_heads={cfg.kv_heads} must be divisible by tp={self.tp}")
         assert (4 * cfg.d_model) % self.tp == 0
         assert cfg.n_experts == 0, (
             "TensorParallelEngine shards the dense FFN; use "

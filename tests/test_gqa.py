@@ -1,0 +1,162 @@
+"""Grouped-query attention (`TransformerConfig.n_kv_heads`).
+
+K/V heads are shared across query groups: the projection splits into
+q / kv params, K/V repeat to the full head count just before the
+attention op (so every substrate works unchanged), and the decode cache
+stores the unrepeated heads — its memory shrinks by the group factor.
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.models.generate import (
+    decode_step, generate, init_kv_cache, prefill)
+from shallowspeed_tpu.optim import Adam, SGD
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                          max_seq=32, n_kv_heads=2)
+MODERN = replace(CFG, rope=True, norm="rmsnorm", ffn="swiglu")
+
+
+def toks(seed=0, b=4, t=32, vocab=64):
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+# ------------------------------------------------------------- structure
+
+
+def test_gqa_param_structure():
+    params = T.init(CFG, seed=1)
+    blk = params["blocks"][0]
+    assert "qkv" not in blk and "q" in blk and "kv" in blk
+    assert blk["q"]["W"].shape == (32, 32)
+    assert blk["kv"]["W"].shape == (32, 2 * 2 * 8)  # 2 kv heads x (k, v)
+    # n_kv_heads == n_heads (or 0) keeps the fused projection
+    for cfg in (replace(CFG, n_kv_heads=0), replace(CFG, n_kv_heads=4)):
+        assert "qkv" in T.init(cfg, seed=1)["blocks"][0]
+
+
+def test_invalid_group_rejected():
+    with pytest.raises(AssertionError, match="divisible by"):
+        T.TransformerConfig(n_heads=4, n_kv_heads=3)
+
+
+def test_cache_stores_unrepeated_heads():
+    cache = init_kv_cache(CFG, batch=2)
+    assert cache[0]["k"].shape == (2, CFG.max_seq, 2, CFG.head_dim)
+
+
+def test_repeat_kv():
+    x = jnp.arange(2 * 3 * 2 * 4).reshape(2, 3, 2, 4).astype(jnp.float32)
+    r = T.repeat_kv(x, CFG)  # group factor 2
+    assert r.shape == (2, 3, 4, 4)
+    np.testing.assert_array_equal(np.asarray(r[:, :, 0]),
+                                  np.asarray(r[:, :, 1]))
+
+
+# ---------------------------------------------------------- equivalence
+
+
+def serial_engine(cfg, opt):
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    return ContextParallelEngine(cfg, opt, mesh, seed=0)
+
+
+@pytest.mark.parametrize("attn", ["ring", "ulysses"])
+def test_gqa_under_sequence_sharding(attn):
+    ser = serial_engine(MODERN, SGD(0.1))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    eng = ContextParallelEngine(MODERN, SGD(0.1), mesh, seed=0, attn=attn)
+    for step in range(3):
+        tok, tgt = toks(step)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), (step, attn)
+
+
+def test_gqa_under_tensor_parallel():
+    """tp=2 with 4 q heads / 2 kv heads: each shard owns 2 q heads and 1
+    kv head; repeat happens per-shard after the column projections."""
+    ser = serial_engine(MODERN, SGD(0.1))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    eng = TensorParallelEngine(MODERN, SGD(0.1), mesh, seed=0)
+    assert "tp" in eng.params["blocks"][0]["kv"]["W"].sharding.spec
+    for step in range(3):
+        tok, tgt = toks(step)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), step
+
+
+def test_gqa_under_pipeline_tp():
+    ser = serial_engine(MODERN, SGD(0.1))
+    devs = np.array(jax.devices()[:4]).reshape(1, 2, 2)
+    eng = PipelineLMEngine(MODERN, SGD(0.1), Mesh(devs, ("dp", "pp", "tp")),
+                           n_mubatches=2, seed=0)
+    for step in range(3):
+        tok, tgt = toks(step, b=8)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ser.train_batch(tok, tgt), rel=3e-4), step
+
+
+def test_kv_heads_indivisible_by_tp_rejected():
+    cfg = replace(CFG, n_kv_heads=1)  # 1 kv head cannot split over tp=2
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "tp"))
+    with pytest.raises(AssertionError, match="n_kv_heads"):
+        TensorParallelEngine(cfg, SGD(0.1), mesh)
+
+
+# ------------------------------------------------------------- decoding
+
+
+def test_gqa_cached_decode_matches_forward():
+    params = T.init(MODERN, seed=4)
+    tokens, _ = toks(1, b=2, t=10)
+    ref = np.asarray(T.forward(params, tokens, MODERN))
+    cache = init_kv_cache(MODERN, 2)
+    logits, cache = prefill(params, tokens[:, :1], MODERN, cache)
+    np.testing.assert_allclose(np.asarray(logits), ref[:, 0],
+                               rtol=1e-4, atol=1e-5)
+    for pos in range(1, tokens.shape[1]):
+        logits, cache = decode_step(params, jnp.asarray(tokens[:, pos]),
+                                    pos, cache, MODERN)
+        np.testing.assert_allclose(np.asarray(logits), ref[:, pos],
+                                   rtol=1e-4, atol=1e-5, err_msg=str(pos))
+
+
+def test_gqa_trains_and_generates():
+    cfg = replace(MODERN, compute_dtype=jnp.bfloat16)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("dp", "sp"))
+    eng = ContextParallelEngine(cfg, Adam(5e-3), mesh, seed=0)
+    tok, tgt = toks(7)
+    losses = [eng.train_batch(tok, tgt) for _ in range(20)]
+    assert losses[-1] < losses[0] - 0.15, losses[::5]
+    out = np.asarray(generate(eng.params, tok[:1, :4], cfg, 8,
+                              temperature=0.0))
+    assert out.shape == (1, 8)
+
+
+def test_gqa_with_moe_engine():
+    """Expert-parallel specs must carry the split q/kv keys under GQA."""
+    from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
+
+    cfg = replace(CFG, n_experts=4, moe_top_k=2)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "ep"))
+    eng = ExpertParallelEngine(cfg, Adam(5e-3), mesh, seed=0)
+    tok, tgt = toks(9)
+    losses = [eng.train_batch(tok, tgt) for _ in range(15)]
+    assert losses[-1] < losses[0] - 0.1, losses[::4]
+
+
+def test_negative_kv_heads_rejected():
+    with pytest.raises(AssertionError, match="non-negative"):
+        T.TransformerConfig(n_heads=4, n_kv_heads=-2)

@@ -48,6 +48,10 @@ def parse_args(argv=None):
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="grouped-query attention: K/V head count "
+                        "(0 = n-heads, plain MHA); the decode KV cache "
+                        "shrinks by n-heads/kv-heads")
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--lr", type=float, default=1e-3)
@@ -222,7 +226,8 @@ def train(args) -> float:
                             moe_top_k=args.moe_top_k,
                             compute_dtype=jnp.bfloat16 if args.bf16 else None,
                             remat=args.remat, rope=args.rope,
-                            norm=args.norm, ffn=args.ffn)
+                            norm=args.norm, ffn=args.ffn,
+                            n_kv_heads=args.kv_heads)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
