@@ -1,0 +1,52 @@
+"""Structured metrics sink (`shallowspeed_tpu/metrics.py`)."""
+
+import json
+
+from shallowspeed_tpu.metrics import MetricsLogger
+
+
+def read_jsonl(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def test_writes_run_start_and_records(tmp_path):
+    p = tmp_path / "sub" / "m.jsonl"  # parent dir is created on demand
+    m = MetricsLogger(p, dp=2, seq_len=128)
+    m.log(event="step", step=3, loss=1.5)
+    m.epoch(epoch=0, accuracy_start=0.1234567, samples=1280,
+            epoch_seconds=2.0)
+    m.final(accuracy=0.95, total_seconds=10.0)
+
+    rows = read_jsonl(p)
+    assert [r["event"] for r in rows] == ["run_start", "step", "epoch",
+                                         "final"]
+    assert rows[0]["dp"] == 2 and rows[0]["seq_len"] == 128
+    assert rows[1]["step"] == 3 and rows[1]["loss"] == 1.5
+    assert rows[2]["samples_per_sec"] == 640.0
+    assert rows[2]["accuracy_start"] == 0.123457  # rounded to 6 places
+    assert rows[3]["accuracy"] == 0.95
+    for r in rows:
+        assert "t" in r and r["t"] >= 0  # relative wall-clock on every row
+
+
+def test_append_only_across_loggers(tmp_path):
+    p = tmp_path / "m.jsonl"
+    MetricsLogger(p).log(event="a")
+    MetricsLogger(p).log(event="b")  # resumed run appends, never truncates
+    events = [r["event"] for r in read_jsonl(p)]
+    assert events == ["run_start", "a", "run_start", "b"]
+
+
+def test_noop_without_path(tmp_path):
+    m = MetricsLogger(None)
+    m.log(event="x")
+    m.epoch(0, 0.5, 100, 1.0)
+    m.final(0.9, 1.0)  # must not raise or write anywhere
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_zero_epoch_seconds_guard(tmp_path):
+    p = tmp_path / "m.jsonl"
+    m = MetricsLogger(p)
+    m.epoch(0, 0.5, 100, 0.0)  # no ZeroDivisionError
+    assert read_jsonl(p)[-1]["samples_per_sec"] == 0.0
