@@ -71,9 +71,5 @@ class Composite3DEngine(GSPMDEngine):
             self._params_host, specs,
             is_leaf=lambda x: isinstance(x, P))
 
-    def batch_spec(self) -> P:
-        return P("dp", "sp")
-
-    def _place(self, arr):
-        assert arr.shape[1] % self.sp == 0, (arr.shape, self.sp)
-        return super()._place(arr)
+    # batch_spec/_place: the GSPMDEngine base keys sequence sharding off
+    # `self.sp` (set in validate), so no overrides are needed here

@@ -1,4 +1,5 @@
-"""Expert parallelism — MoE transformer sharded over a (dp, ep) mesh.
+"""Expert parallelism — MoE transformer over a (dp, ep) or (dp, sp, ep)
+mesh (the 'sp' axis shards the sequence for long-context MoE).
 
 The reference has no MoE / expert parallelism (SURVEY §2 checklist: EP
 absent); this engine adds the family in the GSPMD style the other engines
@@ -9,7 +10,7 @@ Placement:
 - Stacked expert weights `wi/bi/wo/bo` (leading dim E): `P('ep', ...)` —
   each device group owns `E/ep` experts.
 - Router gate, attention, embeddings, layernorms: replicated.
-- Batch over 'dp'.
+- Batch over 'dp' (and the sequence dim over 'sp' when present).
 
 The MoE layer's dispatch einsum (`ops/moe.py`) maps token-sharded
 activations `(G, S, d)` onto the expert-sharded buffer `(E, G, C, d)`;
@@ -46,12 +47,24 @@ def param_specs(cfg: T.TransformerConfig) -> dict:
 
 
 class ExpertParallelEngine(GSPMDEngine):
-    """Data x expert parallel trainer for the MoE transformer family."""
+    """Data x expert parallel trainer for the MoE transformer family.
+
+    Mesh: ('dp', 'ep'), or ('dp', 'sp', 'ep') to also shard the SEQUENCE
+    over 'sp' (long-context MoE). Sequence sharding is purely the batch
+    annotation — the MoE program is logically global, so GSPMD reshards
+    between the (batch, seq)-sharded token layout and the expert-sharded
+    dispatch buffers however the mesh dictates; attention becomes the K/V
+    all-gather formulation over 'sp' (as in `parallel/composite.py`).
+    """
 
     def validate(self, cfg: T.TransformerConfig, mesh: Mesh) -> None:
-        assert mesh.axis_names == ("dp", "ep")
+        assert mesh.axis_names in (("dp", "ep"), ("dp", "sp", "ep")), (
+            f"ExpertParallelEngine expects a ('dp'[,'sp'],'ep') mesh, got "
+            f"{mesh.axis_names}")
         assert cfg.n_experts > 0, "ExpertParallelEngine needs n_experts > 0"
-        self.ep = mesh.devices.shape[1]
+        self.sp = (mesh.devices.shape[1]
+                   if len(mesh.axis_names) == 3 else 1)
+        self.ep = mesh.devices.shape[-1]
         assert cfg.n_experts % self.ep == 0, (
             f"n_experts={cfg.n_experts} must be divisible by ep={self.ep}")
         assert cfg.moe_top_k <= cfg.n_experts, (

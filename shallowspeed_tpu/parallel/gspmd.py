@@ -93,8 +93,11 @@ class GSPMDEngine:
         raise NotImplementedError
 
     def batch_spec(self) -> P:
-        """(batch, seq) token sharding; subclasses with a sequence axis
-        override (e.g. P('dp', 'sp') in the composite 3-D engine)."""
+        """(batch, seq) token sharding: batch over 'dp', and the sequence
+        over 'sp' when the subclass's validate() sets `self.sp > 1`
+        (composite 3-D, long-context MoE)."""
+        if getattr(self, "sp", 1) > 1:
+            return P("dp", "sp")
         return P("dp", None)
 
     # ------------------------------------------------------- training
@@ -114,6 +117,8 @@ class GSPMDEngine:
         # (single-process: arr IS the global batch — the original invariant)
         assert (arr.shape[0] * jax.process_count()) % self.dp == 0, (
             arr.shape, self.dp)
+        sp = getattr(self, "sp", 1)
+        assert arr.shape[1] % sp == 0, (arr.shape, sp)
         assert arr.shape[1] <= self.cfg.max_seq
         return place_global(arr, self.batch)
 

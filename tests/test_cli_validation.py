@@ -24,9 +24,10 @@ def test_ep_requires_experts():
     expect_exit(["--ep", "2"], "--ep requires --experts")
 
 
-def test_ep_excludes_sp_tp():
-    expect_exit(["--ep", "2", "--experts", "2", "--sp", "2"],
-                "--ep composes with --dp only")
+def test_ep_excludes_tp():
+    # --ep + --sp is the supported long-context MoE path; only tp conflicts
+    expect_exit(["--ep", "2", "--experts", "2", "--tp", "2"],
+                "--ep composes with --dp/--sp")
 
 
 def test_fsdp_excludes_ep_and_zero1():

@@ -158,3 +158,19 @@ def test_moe_training_learns():
     losses = [eng.train_batch(tok, tgt) for _ in range(8)]
     assert losses[-1] < losses[0] * 0.7, losses
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_moe_with_sequence_sharding():
+    """Long-context MoE: a ('dp','sp','ep') mesh must reproduce the
+    ('dp','ep') trajectory — sequence sharding is purely the batch
+    annotation; GSPMD reshards tokens<->expert buffers either way."""
+    ref = ExpertParallelEngine(MOE_CFG, SGD(0.1), ep_mesh(1, 4), seed=0)
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    eng = ExpertParallelEngine(MOE_CFG, SGD(0.1),
+                               Mesh(devs, ("dp", "sp", "ep")), seed=0)
+    rng = np.random.default_rng(3)
+    for step in range(3):
+        tok = rng.integers(0, MOE_CFG.vocab, (4, 16)).astype(np.int32)
+        tgt = np.roll(tok, -1, axis=1).astype(np.int32)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ref.train_batch(tok, tgt), rel=3e-4), step

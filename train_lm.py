@@ -40,7 +40,8 @@ def parse_args(argv=None):
                         "(explicit psum inside the pipeline)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel degree (requires --experts > 0); "
-                        "composes with --dp only")
+                        "composes with --dp, and with --sp on a "
+                        "(dp, sp, ep) mesh for long-context MoE")
     p.add_argument("--experts", type=int, default=0,
                    help="number of MoE experts per block (0 = dense FFN)")
     p.add_argument("--moe-top-k", type=int, default=2)
@@ -180,8 +181,8 @@ def train(args) -> float:
     if args.pp > 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with --pp "
                          "(the pipeline engine uses XLA attention)")
-    if args.ep > 1 and (args.sp > 1 or args.tp > 1):
-        raise SystemExit("--ep composes with --dp only (not --sp/--tp)")
+    if args.ep > 1 and args.tp > 1:
+        raise SystemExit("--ep composes with --dp/--sp (not --tp)")
     if args.fsdp and (args.ep > 1 or args.experts or args.zero1):
         raise SystemExit("--fsdp composes with --dp/--sp/--tp (and already "
                          "subsumes --zero1; MoE uses --ep)")
@@ -194,9 +195,9 @@ def train(args) -> float:
                          "parallelism is the K/V all-gather formulation)")
     if args.ep > 1 and args.experts == 0:
         raise SystemExit("--ep requires --experts > 0")
-    if args.experts and (args.sp > 1 or args.tp > 1):
-        raise SystemExit("--experts composes with --dp/--ep only (not "
-                         "--sp/--tp) for now")
+    if args.experts and args.tp > 1:
+        raise SystemExit("--experts composes with --dp/--sp/--ep (not "
+                         "--tp) for now")
     if args.experts and args.moe_top_k > args.experts:
         raise SystemExit(f"--moe-top-k {args.moe_top_k} cannot exceed "
                          f"--experts {args.experts}")
@@ -207,6 +208,8 @@ def train(args) -> float:
         model_par = args.sp * args.tp
     elif args.pp > 1:
         model_par = args.pp * args.tp
+    elif (args.ep > 1 or args.experts) and args.sp > 1:
+        model_par = args.sp * args.ep  # long-context MoE: (dp, sp, ep)
     else:
         model_par = max(args.tp, args.sp, args.ep)
     n_dev = len(jax.devices())
@@ -266,7 +269,11 @@ def train(args) -> float:
     elif args.ep > 1 or args.experts:
         from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
 
-        mesh = Mesh(devs.reshape(args.dp, args.ep), ("dp", "ep"))
+        if args.sp > 1:
+            mesh = Mesh(devs.reshape(args.dp, args.sp, args.ep),
+                        ("dp", "sp", "ep"))
+        else:
+            mesh = Mesh(devs.reshape(args.dp, args.ep), ("dp", "ep"))
         engine = ExpertParallelEngine(cfg, opt, mesh, seed=args.seed,
                                       zero1=args.zero1)
     elif args.tp > 1:
