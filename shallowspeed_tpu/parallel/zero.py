@@ -1,4 +1,4 @@
-"""ZeRO-1 optimizer-state sharding over the data-parallel axis.
+"""ZeRO-1 / ZeRO-2 sharding over the data-parallel axis.
 
 The reference replicates optimizer state on every DP rank (its own SGD is
 stateless, `/root/reference/shallowspeed/optimizer.py:4-13`, but its PyTorch
@@ -22,6 +22,19 @@ GSPMD then partitions the elementwise update where the moments live — each
 device updates only its 1/dp slice — and inserts the parameter all-gather
 itself. The compiler derives exactly the communication pattern DeepSpeed's
 implementation hand-codes, and remains free to fuse/schedule it.
+
+**ZeRO-2** adds gradient sharding on top: the gradient program emits each
+grad leaf dp-sharded instead of replicated, so the DP reduction lowers to
+a *reduce-scatter* (half an all-reduce's bytes on the wire) and the
+persistent grad buffer handed to the update is 1/dp per device, matching
+the moments' placement — the update stays fully local, and only the new
+parameters are all-gathered. Two equivalent formulations, one per engine
+style (`zero2_grad_specs` serves both):
+
+- GSPMD engines: pin the grad outputs' `out_shardings`; XLA's partial-sum
+  propagation turns the all-reduce into reduce-scatter on its own.
+- shard_map engines: pvary the params so cotangents arrive as per-tile
+  partials, then `lax.psum_scatter` each leaf over 'dp' explicitly.
 """
 
 from __future__ import annotations
@@ -48,18 +61,31 @@ def _spec_axes_used(spec: P) -> set:
     return used
 
 
-def _with_axis(spec: P, shape, size: int, axis: str) -> P:
-    """Add `axis` to the first unsharded dimension divisible by `size`;
-    return the spec unchanged if no dimension qualifies (leaf stays at its
-    current — typically replicated — placement)."""
+def zero2_grad_dim(spec: P, shape, size: int, axis: str = "dp"):
+    """The dimension `axis` lands on for a leaf with this spec/shape —
+    the first unsharded dimension divisible by `size` — or None if no
+    dimension qualifies. THE single encoding of the placement rule:
+    `_with_axis` (moment placement) builds on it, so ZeRO-2 grad sharding
+    and ZeRO-1 moment sharding can never diverge."""
     if axis in _spec_axes_used(spec):
-        return spec
+        return None
     entries = list(spec) + [None] * (len(shape) - len(spec))
     for i, dim in enumerate(shape):
         if entries[i] is None and dim and dim % size == 0:
-            entries[i] = axis
-            return P(*entries)
-    return spec
+            return i
+    return None
+
+
+def _with_axis(spec: P, shape, size: int, axis: str) -> P:
+    """Add `axis` to the leaf's `zero2_grad_dim` dimension; return the
+    spec unchanged if no dimension qualifies (leaf stays at its current —
+    typically replicated — placement)."""
+    i = zero2_grad_dim(spec, shape, size, axis)
+    if i is None:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    entries[i] = axis
+    return P(*entries)
 
 
 def shard_state_zero1(opt_state: Any, mesh: Mesh, axis: str = "dp") -> Any:
@@ -77,6 +103,19 @@ def shard_state_zero1(opt_state: Any, mesh: Mesh, axis: str = "dp") -> Any:
             leaf, NamedSharding(mesh, _with_axis(cur, leaf.shape, size, axis)))
 
     return tree_map(place, opt_state)
+
+
+def zero2_grad_specs(params: Any, mesh: Mesh, axis: str = "dp") -> Any:
+    """PartitionSpec pytree for dp-sharded gradients: each leaf's current
+    spec with `axis` added on its `zero2_grad_dim` (unchanged if none)."""
+    size = mesh.shape[axis]
+
+    def spec_of(leaf):
+        sh = getattr(leaf, "sharding", None)
+        cur = sh.spec if isinstance(sh, NamedSharding) else P()
+        return _with_axis(cur, leaf.shape, size, axis)
+
+    return tree_map(spec_of, params)
 
 
 def make_zero1_update(optimizer, params: Any, opt_state: Any):
