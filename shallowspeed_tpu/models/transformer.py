@@ -83,10 +83,22 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 1e-2
+    # Dropout rate on the embedding sum, each attention output, and each
+    # FFN output (GPT-2 placement; attention-probability dropout is
+    # deliberately omitted — it would not compose with the fused
+    # flash/ring substrates). Active only when a `dropout_key` is
+    # threaded into the forward: training steps pass a per-step key,
+    # eval/decode paths pass None, so train/eval mode is a property of
+    # the CALL, not of mutable model state (contrast the reference's
+    # `Module.train()/eval()` flag, `layers.py:56-64`). Keys are derived
+    # deterministically from (step, microbatch, layer), which makes the
+    # masks reproducible under remat and 1F1B vjp recompute.
+    dropout: float = 0.0
 
     def __post_init__(self):
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
         assert self.ffn in ("gelu", "swiglu"), self.ffn
+        assert 0.0 <= self.dropout < 1.0, self.dropout
         assert self.n_kv_heads >= 0, (
             f"n_kv_heads must be non-negative, got {self.n_kv_heads}")
         assert self.n_heads % self.kv_heads == 0, (
@@ -195,6 +207,16 @@ def _dense(p, x):
     return x @ p["W"] + p["b"]
 
 
+def _dropout(x, rate: float, key):
+    """Inverted dropout; identity when `key` is None or rate is 0 (the
+    static no-op keeps eval/decode traces free of RNG ops)."""
+    if key is None or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
 def rope_rotate(x, pos, theta: float = 10000.0):
     """Apply rotary embeddings to (B, T, H, D) at global positions `pos`
     (shape (T,) int, or a scalar for single-token decode). Pairs dimension
@@ -236,27 +258,33 @@ def repeat_kv(x, cfg: TransformerConfig):
     return x if g == 1 else jnp.repeat(x, g, axis=2)
 
 
-def _ffn(p, x, cfg: TransformerConfig, h):
+def _ffn(p, x, cfg: TransformerConfig, h, key=None):
     """Post-attention half of a block: FFN (dense GELU, SwiGLU, or routed
-    MoE) on the norm output `h`, residual onto `x`. Returns (x, aux)."""
+    MoE) on the norm output `h`, dropout, residual onto `x`.
+    Returns (x, aux)."""
     if "moe" in p:
         y, aux = moe_ffn(p["moe"], h, cfg.moe_top_k, cfg.moe_capacity_factor)
-        return x + y, aux
+        return x + _dropout(y, cfg.dropout, key), aux
     if "gate" in p:  # SwiGLU: silu(gate) * up, both column-parallel
         u = jax.nn.silu(_dense(p["gate"], h)) * _dense(p["up"], h)
-        return x + _dense(p["down"], u), 0.0
-    return x + _dense(p["down"], jax.nn.gelu(_dense(p["up"], h))), 0.0
+    else:
+        u = jax.nn.gelu(_dense(p["up"], h))
+    return x + _dropout(_dense(p["down"], u), cfg.dropout, key), 0.0
 
 
 def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
-           pos=None):
+           pos=None, key=None):
     """One pre-LN block; returns (x, aux) where aux is the MoE
     load-balancing loss (0.0 for dense blocks). With `with_kv` also
     returns this block's (k, v) — the decode prefill
     (`models/generate.py`) captures them into its cache; the training
     path never requests them, so XLA dead-code-eliminates the extra
-    outputs there. `pos` (global positions) is required when cfg.rope."""
+    outputs there. `pos` (global positions) is required when cfg.rope.
+    `key` (training only) seeds this block's attention/FFN dropout."""
     b, t, d = x.shape
+    k_attn = k_ffn = None
+    if key is not None and cfg.dropout > 0.0:
+        k_attn, k_ffn = jax.random.split(key)
     h = _norm(p["ln1"], x, cfg)
     # head-major fused layout (H, 3, D): a contiguous slice of the 3d output
     # dim is a whole group of heads, so tensor-parallel column sharding of
@@ -270,22 +298,24 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
         k = rope_rotate(k, pos, cfg.rope_theta)
     kv_cacheable = (k, v)  # rotated, UNREPEATED — the decode cache layout
     a = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, t, d)
-    x = x + _dense(p["proj"], a)
+    x = x + _dropout(_dense(p["proj"], a), cfg.dropout, k_attn)
     h = _norm(p["ln2"], x, cfg)
-    x, aux = _ffn(p, x, cfg, h)
+    x, aux = _ffn(p, x, cfg, h, k_ffn)
     if with_kv:
         return x, aux, kv_cacheable
     return x, aux
 
 
 def forward_with_aux(params, tokens, cfg: TransformerConfig,
-                     attn_fn=None, pos_offset=0):
+                     attn_fn=None, pos_offset=0, dropout_key=None):
     """tokens: (batch, seq) int32 -> (logits (batch, seq, vocab), moe aux).
 
     `attn_fn(q, k, v)` defaults to full causal attention; a context-parallel
     caller passes `partial(ring_attention, axis_name='sp')` and the global
     `pos_offset` of its sequence block (positions are global under sequence
-    sharding).
+    sharding). `dropout_key` (training only) activates cfg.dropout; per-
+    layer keys are fold_in-derived, so remat recompute sees identical
+    masks.
     """
     if attn_fn is None:
         attn_fn = partial(attention, causal=True)
@@ -298,29 +328,37 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
         assert pos_offset + t <= cfg.max_seq, (
             f"sequence positions [{pos_offset}, {pos_offset + t}) exceed "
             f"max_seq={cfg.max_seq}")
+    if cfg.dropout == 0.0:
+        dropout_key = None
     pos = pos_offset + jnp.arange(t)
     x = params["tok_emb"][tokens]
     if not cfg.rope:  # rope replaces the learned absolute embedding
         x = x + params["pos_emb"][pos]
+    if dropout_key is not None:
+        x = _dropout(x, cfg.dropout,
+                     jax.random.fold_in(dropout_key, cfg.n_layers))
     aux_total = 0.0
     block_fn = _block
     if cfg.remat:
         block_fn = jax.checkpoint(_block, static_argnums=(2, 3, 4))
-    for blk in params["blocks"]:
-        x, aux = block_fn(blk, x, cfg, attn_fn, False, pos)
+    for i, blk in enumerate(params["blocks"]):
+        k_i = (None if dropout_key is None
+               else jax.random.fold_in(dropout_key, i))
+        x, aux = block_fn(blk, x, cfg, attn_fn, False, pos, k_i)
         aux_total = aux_total + aux
     x = _norm(params["ln_f"], x, cfg)
     return _dense(params["head"], x), aux_total
 
 
 def forward(params, tokens, cfg: TransformerConfig,
-            attn_fn=None, pos_offset=0):
+            attn_fn=None, pos_offset=0, dropout_key=None):
     """Logits only (see `forward_with_aux` for the MoE aux loss)."""
-    return forward_with_aux(params, tokens, cfg, attn_fn, pos_offset)[0]
+    return forward_with_aux(params, tokens, cfg, attn_fn, pos_offset,
+                            dropout_key)[0]
 
 
 def loss(params, tokens, targets, cfg: TransformerConfig,
-         attn_fn=None, pos_offset=0):
+         attn_fn=None, pos_offset=0, dropout_key=None):
     """Mean softmax cross-entropy over all (batch, seq) positions, plus the
     weighted MoE load-balancing aux loss when the config has experts.
 
@@ -328,7 +366,8 @@ def loss(params, tokens, targets, cfg: TransformerConfig,
     the caller averages across shards (`lax.pmean`) — exact because all
     blocks have equal size.
     """
-    logits, aux = forward_with_aux(params, tokens, cfg, attn_fn, pos_offset)
+    logits, aux = forward_with_aux(params, tokens, cfg, attn_fn, pos_offset,
+                                   dropout_key)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean() + cfg.moe_aux_weight * aux
