@@ -39,6 +39,28 @@ TPU-native way available:
   psums over ('dp', 'pp'). The DP all-reduce the reference interleaves
   by hand (`pipe.py:302-327`) is, again, the transpose of a broadcast.
 
+A second compiled schedule, **1F1B / PipeDream-Flush** (`schedule=
+"1f1b"`), hand-schedules what GPipe leaves to autodiff. The reference
+declares PipeDream but crashes on it (`pipe.py:297-299`); the pipeline
+VM here runs it interpreted (`parallel/worker.py`); this is the
+fully-compiled SPMD form:
+
+- **Closed-form conflict-free slots.** Stage s runs FWD of microbatch m
+  at tick `2m + s` and BWD at tick `2m + 2pp - 1 - s`. The two families
+  never collide (their difference is odd), every send is consumed
+  exactly one tick later (no rx queues), and the total tick count,
+  `2(n_mu + pp - 1)`, equals GPipe's fwd+bwd ticks — same bubble, same
+  compute.
+- **Bounded activation memory.** The backward recomputes each stage from
+  a stashed *stage input* (`jax.vjp` per tick), so the stash holds at
+  most `min(pp, n_mu)` microbatch inputs — the 1F1B in-flight bound —
+  instead of GPipe's `n_mu + pp - 1` saved tick residuals. Microbatch
+  count no longer costs memory: crank n_mu to shrink the bubble.
+- **Ticks skip, not mask.** Each tick gates its F and B halves behind
+  `lax.cond`, so inactive slots cost nothing; only the two `ppermute`
+  hops (activations right, cotangents left) run unconditionally, as
+  collectives must.
+
 Composes with mixed precision (`compute_dtype`) and remat (recompute each
 stage's blocks in the backward). MoE configs are rejected — experts
 compose with dp/ep (`parallel/expert.py`).
@@ -91,13 +113,21 @@ class PipelineLMEngine:
 
     tokens/targets: (B, T) with B sharded over dp; each dp shard is split
     into `n_mubatches` microbatches that stream through the pp stages.
+
+    `schedule` picks the compiled pipeline schedule: "gpipe" (all-FWD
+    then all-BWD, backward derived by autodiff) or "1f1b"
+    (PipeDream-Flush: hand-scheduled slots, `min(pp, n_mu)`-deep
+    stage-input stash, backward rebuilt per tick with `jax.vjp`).
     """
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 n_mubatches: int = 4, seed: int = 0):
+                 n_mubatches: int = 4, seed: int = 0,
+                 schedule: str = "gpipe"):
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp")), (
             f"PipelineLMEngine expects a ('dp','pp'[,'tp']) mesh, got "
             f"{mesh.axis_names}")
+        assert schedule in ("gpipe", "1f1b"), schedule
+        self.schedule = schedule
         assert cfg.n_experts == 0, (
             "PipelineLMEngine pipelines the dense family; MoE composes "
             "with dp/ep (parallel/expert.py)")
@@ -114,7 +144,10 @@ class PipelineLMEngine:
             f"n_kv_heads={cfg.kv_heads} must be divisible by tp={self.tp}")
         assert (4 * cfg.d_model) % self.tp == 0
         self.n_mu = n_mubatches
+        self.l_local = cfg.n_layers // self.pp
         self.optimizer = optimizer
+        self._seed = seed
+        self._step_count = 0
 
         self.rep = NamedSharding(mesh, P())
         self.row = NamedSharding(mesh, P("dp"))
@@ -181,13 +214,19 @@ class PipelineLMEngine:
             def psum_tp(x):
                 return x
 
-        def mega_block(blk, x):
+        def mega_block(blk, x, key=None):
             """One pre-LN block on this device's tp shard: qkv/up columns
             hold `heads_local` whole heads / `4d/tp` neurons, proj/down
             rows are partial-summed over 'tp' (one all-reduce per matmul
             pair, Megatron placement). With tp absent this is exactly
-            `T._block`'s dense path."""
+            `T._block`'s dense path. `key` (training only) seeds the
+            attention/FFN dropout; it is tp-invariant by construction, so
+            every tp peer draws the SAME mask on the (full-size) residual
+            stream — required for the psum'd partial sums to stay exact."""
             b, t, d = x.shape
+            k_attn = k_ffn = None
+            if key is not None and cfg.dropout > 0.0:
+                k_attn, k_ffn = jax.random.split(key)
             h = T._norm(blk["ln1"], x, cfg)
             if cfg.gqa:  # split projections; each shard owns whole groups
                 q = (h @ blk["q"]["W"] + blk["q"]["b"]).reshape(
@@ -207,7 +246,9 @@ class PipelineLMEngine:
             v = T.repeat_kv(v, cfg)
             a = attention(q, k, v, causal=True).reshape(
                 b, t, heads_local * hd)
-            x = x + psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"]
+            x = x + T._dropout(
+                psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"],
+                cfg.dropout, k_attn)
             h = T._norm(blk["ln2"], x, cfg)
             if cfg.ffn == "swiglu":
                 # gate/up share the same column partition, so the
@@ -216,19 +257,47 @@ class PipelineLMEngine:
                      * (h @ blk["up"]["W"] + blk["up"]["b"]))
             else:
                 u = jax.nn.gelu(h @ blk["up"]["W"] + blk["up"]["b"])
-            return x + psum_tp(u @ blk["down"]["W"]) + blk["down"]["b"]
+            return x + T._dropout(
+                psum_tp(u @ blk["down"]["W"]) + blk["down"]["b"],
+                cfg.dropout, k_ffn)
 
-        def apply_blocks(blocks, x):
-            """This stage's l_local blocks; optionally rematerialized."""
-            def body(h, blk):
-                return mega_block(blk, h), None
+        def apply_blocks(blocks, x, key=None):
+            """This stage's l_local blocks; optionally rematerialized.
+            `key` is this (microbatch, stage)'s dropout key — split into
+            one key per block; explicit keys mean remat (and the 1F1B
+            vjp recompute) regenerate bit-identical masks."""
+            if key is None:
+                def body(h, blk):
+                    return mega_block(blk, h), None
+
+                if cfg.remat:
+                    body = jax.checkpoint(body)
+                x, _ = jax.lax.scan(body, x, blocks)
+                return x
+
+            def body(h, xs):
+                blk, k = xs
+                return mega_block(blk, h, k), None
 
             if cfg.remat:
                 body = jax.checkpoint(body)
-            x, _ = jax.lax.scan(body, x, blocks)
+            keys = jax.random.split(key, self.l_local)
+            x, _ = jax.lax.scan(body, x, (blocks, keys))
             return x
 
-        def local_loss(params, tokens, targets):
+        def mu_key(base, m):
+            """Per-(step, microbatch, dp-tile, stage) dropout key — the
+            SAME derivation in the GPipe and 1F1B builds, so the two
+            schedules produce bit-identical masks (asserted in tests)."""
+            if base is None:
+                return None, None
+            k = jax.random.fold_in(
+                jax.random.fold_in(base, m), jax.lax.axis_index("dp"))
+            k_stage = jax.random.fold_in(k, jax.lax.axis_index("pp"))
+            k_emb = jax.random.fold_in(k, pp)  # stage ids are < pp
+            return k_stage, k_emb
+
+        def local_loss(params, tokens, targets, key=None):
             """Inside shard_map: tokens/targets (n_mu, mubs, T) local rows.
             Returns the global-mean NLL (invariant over the mesh)."""
             s = jax.lax.axis_index("pp")
@@ -242,13 +311,15 @@ class PipelineLMEngine:
                 m = jnp.clip(tk - s, 0, n_mu - 1)
                 active = (tk - s >= 0) & (tk - s < n_mu)
                 tok_m = jax.lax.dynamic_index_in_dim(tokens, m, 0, False)
+                k_stage, k_emb = mu_key(key, m)
                 x_own = params["tok_emb"][tok_m]
                 if not cfg.rope:  # rope replaces the learned pos embedding
                     x_own = x_own + params["pos_emb"][pos]
                 if cfg.compute_dtype is not None:
                     x_own = x_own.astype(cfg.compute_dtype)
+                x_own = T._dropout(x_own, cfg.dropout, k_emb)
                 x_in = jnp.where(is_first, x_own, cur)
-                h = apply_blocks(params["blocks"], x_in)
+                h = apply_blocks(params["blocks"], x_in, k_stage)
                 # last stage: this microbatch's mean token NLL
                 hf = T._norm(params["ln_f"], h, cfg)
                 logits = T._dense(params["head"], hf).astype(jnp.float32)
@@ -270,22 +341,198 @@ class PipelineLMEngine:
             # mean over dp and microbatches recovers the global mean
             return (jax.lax.psum(loss_sum, "pp") / n_mu).mean(), None
 
-        def grads_and_loss(params, tokens, targets):
+        def grads_and_loss(params, tokens, targets, key):
             (loss, _), grads = jax.value_and_grad(
-                local_loss, has_aux=True)(params, tokens, targets)
+                local_loss, has_aux=True)(params, tokens, targets, key)
             # variance typing does the reductions: block grads arrive
             # psum'd over dp (params dp-invariant), embed/head grads
             # psum'd over (dp, pp) (fully invariant)
             return jax.lax.pmean(loss, "dp"), grads
 
+        # ------------------------------------------- 1F1B (PipeDream-Flush)
+
+        left = [(i, (i - 1) % pp) for i in range(pp)]
+        stash_depth = min(pp, n_mu)
+        # pvary over (dp, pp) ONLY: the per-tick vjp must not auto-psum
+        # over those axes (their reduction happens once, after the scan),
+        # but 'tp' reductions stay with variance-typed autodiff — it
+        # knows exactly which cotangents are tp-partial (ln/bias/embed/
+        # inter-stage dx get the Megatron per-microbatch psum) and which
+        # are already tp-complete (head, behind the activation psum)
+        vary_axes = ("dp", "pp")
+
+        def _spec_axes(spec: P) -> set:
+            used = set()
+            for e in spec:
+                if e is None:
+                    continue
+                for a in (e if isinstance(e, tuple) else (e,)):
+                    used.add(a)
+            return used
+
+        # per-leaf mesh axes a gradient must be summed over = the axes its
+        # parameter is invariant on (autodiff's variance typing derives
+        # this in the GPipe path; the hand-built backward does it by spec)
+        grad_psum_axes = [
+            tuple(a for a in vary_axes if a not in _spec_axes(sp))
+            for sp in jax.tree_util.tree_leaves(
+                self._pspecs, is_leaf=lambda x: isinstance(x, P))]
+
+        def stage_fwd(params_c, x_in, tok_m, tgt_m, keys=(None, None)):
+            """One stage's whole tick on already-cast params: embed (if
+            first), this stage's blocks, head + token NLL (cotangent-
+            masked to the last stage). Differentiable in (params_c, x_in);
+            the same function serves F ticks (primal) and B ticks (vjp
+            recompute from the stashed x_in — `keys` are derived from the
+            microbatch id, so the recompute draws identical dropout
+            masks)."""
+            k_stage, k_emb = keys
+            s = jax.lax.axis_index("pp")
+            t = tok_m.shape[-1]
+            x_own = params_c["tok_emb"][tok_m]
+            if not cfg.rope:
+                x_own = x_own + params_c["pos_emb"][jnp.arange(t)]
+            if cfg.compute_dtype is not None:
+                x_own = x_own.astype(cfg.compute_dtype)
+            x_own = T._dropout(x_own, cfg.dropout, k_emb)
+            x = jnp.where(s == 0, x_own, x_in)
+            h = apply_blocks(params_c["blocks"], x, k_stage)
+            hf = T._norm(params_c["ln_f"], h, cfg)
+            logits = T._dense(params_c["head"], hf).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, tgt_m[..., None], axis=-1)[..., 0].mean()
+            return h, nll
+
+        def local_1f1b(params, tokens, targets, key=None):
+            """The full 1F1B batch step body (inside shard_map): returns
+            (local-mean loss, accumulated f32 grads). Slot algebra:
+            F(s, m) at tick 2m+s, B(s, m) at tick 2m+2pp-1-s — disjoint
+            (odd difference), immediate-consumption both directions."""
+            s = jax.lax.axis_index("pp")
+            is_last = s == pp - 1
+            # pvary the cast params to fully-varying BEFORE the vjp:
+            # variance-typed autodiff would otherwise auto-psum each
+            # invariant param's cotangent inside every B tick (a full
+            # grad all-reduce per tick); varying params keep cotangents
+            # local, and the one psum after the scan does the reduction
+            params_c = _pvary(T.cast_params(params, cfg.compute_dtype),
+                              vary_axes)
+            mubs, t = tokens.shape[1], tokens.shape[2]
+            dt = cfg.compute_dtype or cfg.dtype
+            act_shape = (mubs, t, cfg.d_model)
+
+            def zeros_act():
+                return jnp.zeros(act_shape, dt)
+
+            def tick(carry, tk):
+                x_rx, g_rx, stash, grads, loss_acc = carry
+
+                # ---- F half: fwd microbatch mF, stash its stage input
+                f_rel = tk - s
+                f_act = (f_rel >= 0) & (f_rel < 2 * n_mu) & (f_rel % 2 == 0)
+                mF = jnp.clip(f_rel // 2, 0, n_mu - 1)
+                tokF = jax.lax.dynamic_index_in_dim(tokens, mF, 0, False)
+                tgtF = jax.lax.dynamic_index_in_dim(targets, mF, 0, False)
+
+                def do_f(x_rx, stash):
+                    h, nll = stage_fwd(params_c, x_rx, tokF, tgtF,
+                                       mu_key(key, mF))
+                    stash = jax.lax.dynamic_update_index_in_dim(
+                        stash, x_rx, mF % stash_depth, 0)
+                    return h, nll, stash
+
+                def skip_f(x_rx, stash):
+                    # zeros are axis-invariant; pvary so both cond
+                    # branches carry the same variance type
+                    return (_pvary((zeros_act(), jnp.float32(0.0)),
+                                   vary_axes) + (stash,))
+
+                h_out, nll, stash = jax.lax.cond(
+                    f_act, do_f, skip_f, x_rx, stash)
+                loss_acc = loss_acc + jnp.where(f_act & is_last, nll, 0.0)
+
+                # ---- B half: vjp-recompute microbatch mB from the stash
+                b_rel = tk - (2 * pp - 1 - s)
+                b_act = (b_rel >= 0) & (b_rel < 2 * n_mu) & (b_rel % 2 == 0)
+                mB = jnp.clip(b_rel // 2, 0, n_mu - 1)
+                tokB = jax.lax.dynamic_index_in_dim(tokens, mB, 0, False)
+                tgtB = jax.lax.dynamic_index_in_dim(targets, mB, 0, False)
+
+                def do_b(g_rx, stash):
+                    x_saved = jax.lax.dynamic_index_in_dim(
+                        stash, mB % stash_depth, 0, False)
+                    keysB = mu_key(key, mB)
+                    _, vjp = jax.vjp(
+                        lambda p, xi: stage_fwd(p, xi, tokB, tgtB, keysB),
+                        params_c, x_saved)
+                    # last stage seeds from the loss (1/n_mu per
+                    # microbatch — the transpose of the loss mean);
+                    # earlier stages from the cotangent ppermuted in
+                    dh = jnp.where(is_last, jnp.zeros_like(g_rx), g_rx)
+                    dnll = _pvary(
+                        jnp.float32(jnp.where(is_last, 1.0 / n_mu, 0.0)),
+                        vary_axes)
+                    dp_, dx = vjp((dh, dnll))
+                    return dp_, dx
+
+                def skip_b(g_rx, stash):
+                    return _pvary((tree_map(jnp.zeros_like, params_c),
+                                   zeros_act()), vary_axes)
+
+                dparams, dx_out = jax.lax.cond(b_act, do_b, skip_b,
+                                               g_rx, stash)
+                grads = tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), grads, dparams)
+
+                # ---- comms: activations right, cotangents left — both
+                # consumed exactly one tick later by schedule construction
+                x_nxt = jax.lax.ppermute(h_out, "pp", right)
+                g_nxt = jax.lax.ppermute(dx_out, "pp", left)
+                return (x_nxt, g_nxt, stash, grads, loss_acc), None
+
+            init = _pvary(
+                (zeros_act(), zeros_act(),
+                 jnp.zeros((stash_depth,) + act_shape, dt),
+                 tree_map(lambda l: jnp.zeros_like(l, jnp.float32),
+                          params),
+                 jnp.float32(0.0)),
+                vary_axes)
+            (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+                tick, init, jnp.arange(2 * (n_mu + pp - 1)))
+
+            g_leaves, tdef = jax.tree_util.tree_flatten(grads)
+            g_leaves = [jax.lax.psum(g, ax) if ax else g
+                        for g, ax in zip(g_leaves, grad_psum_axes)]
+            grads = jax.tree_util.tree_unflatten(tdef, g_leaves)
+            loss = jax.lax.psum(loss_sum, "pp") / n_mu
+            if self.has_tp:
+                # all tp peers computed the same value, but the pvaried
+                # params typed it tp-varying; pmean is exact and re-types
+                loss = jax.lax.pmean(loss, "tp")
+            return loss, grads
+
         pspecs, ospecs = self._pspecs, self._opt_specs
+        use_1f1b = self.schedule == "1f1b"
+        seed = self._seed
+
+        def train_key(step):
+            if cfg.dropout == 0.0:
+                return None
+            return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         @partial(shard_map, mesh=self.mesh,
-                 in_specs=(pspecs, ospecs, P(None, "dp"), P(None, "dp")),
+                 in_specs=(pspecs, ospecs, P(None, "dp"), P(None, "dp"),
+                           P()),
                  out_specs=(pspecs, ospecs, P()))
-        def _step(params, opt_state, tokens, targets):
-            loss, grads = grads_and_loss(params, tokens, targets)
+        def _step(params, opt_state, tokens, targets, step):
+            key = train_key(step)
+            if use_1f1b:
+                loss, grads = local_1f1b(params, tokens, targets, key)
+                loss = jax.lax.pmean(loss, "dp")
+            else:
+                loss, grads = grads_and_loss(params, tokens, targets, key)
             # dp-mean gradient: psum'd sums / dp (tiles are equal-sized)
             grads = tree_map(lambda g: g / self.dp, grads)
             params, opt_state = opt.step(params, grads, opt_state)
@@ -327,9 +574,11 @@ class PipelineLMEngine:
     # ---------------------------------------------------------------- steps
 
     def train_batch_async(self, tokens, targets) -> jax.Array:
+        step = np.uint32(self._step_count)
+        self._step_count += 1
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, self.place(tokens),
-            self.place(targets))
+            self.place(targets), step)
         return loss
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
