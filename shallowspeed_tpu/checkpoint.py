@@ -169,4 +169,10 @@ def restore(engine, ckpt_path) -> int:
         warnings.warn(
             f"checkpoint opt state is {meta['engine']}-shaped and does not "
             f"match this {type(engine).__name__}'s topology; re-initializing")
-    return int(meta["epoch"]) + 1
+    nxt = int(meta["epoch"]) + 1
+    if hasattr(engine, "_step_count"):
+        # dropout keys derive from the per-engine step counter: resume it
+        # at the global step so a resumed run draws the SAME mask stream
+        # an uninterrupted run would (train_lm's exact-resume contract)
+        engine._step_count = nxt
+    return nxt

@@ -56,12 +56,15 @@ class ContextParallelEngine:
     """
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0, attn: str = "ring", zero1: bool = False):
+                 seed: int = 0, attn: str = "ring", zero1: bool = False,
+                 zero2: bool = False):
         assert mesh.axis_names == ("dp", "sp")
+        assert not (zero1 and zero2), "zero2 subsumes zero1"
         self.cfg = cfg
         self.mesh = mesh
         self.dp, self.sp = mesh.devices.shape
         self.optimizer = optimizer
+        self._step_count = 0
         self.rep = NamedSharding(mesh, P())
         self.tile = NamedSharding(mesh, P("dp", "sp"))
 
@@ -83,15 +86,28 @@ class ContextParallelEngine:
         else:
             attn = partial(ring_attention, axis_name="sp", causal=True)
 
-        def local_loss(params, tokens, targets):
+        sp = self.sp
+
+        def local_loss(params, tokens, targets, key=None):
             t_local = tokens.shape[1]
             off = jax.lax.axis_index("sp") * t_local
+            if key is not None:
+                # decorrelate masks across tiles: each (dp, sp) position
+                # folds its mesh coordinates into the per-step key
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index("dp") * sp
+                    + jax.lax.axis_index("sp"))
             return T.loss(params, tokens, targets, cfg,
-                          attn_fn=attn, pos_offset=off)
+                          attn_fn=attn, pos_offset=off, dropout_key=key)
+
+        def train_key(step):
+            if cfg.dropout == 0.0:
+                return None
+            return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 
         n_tiles = self.dp * self.sp
 
-        def loss_and_grads(params, tokens, targets):
+        def loss_and_grads(params, tokens, targets, step):
             # Params are mesh-invariant (replicated), the per-tile loss is
             # varying: jax.grad's transpose of that broadcast IS a psum over
             # ('dp','sp') — the gradient arrives already summed across tiles.
@@ -100,25 +116,80 @@ class ContextParallelEngine:
             # the DP all-reduce emitted by autodiff instead of hand-placed
             # (the XLA-native version of the reference's interleaved
             # Iallreduce, `pipe.py:302-327`).
+            key = train_key(step)
+
             def scaled(p):
-                return local_loss(p, tokens, targets) / n_tiles
+                return local_loss(p, tokens, targets, key) / n_tiles
 
             lloc, grads = jax.value_and_grad(scaled)(params)
             return jax.lax.pmean(lloc * n_tiles, ("dp", "sp")), grads
 
-        if zero1:
+        if zero2:
+            from shallowspeed_tpu.parallel.zero import (
+                make_zero1_update, shard_state_zero1, zero2_grad_specs)
+            from shallowspeed_tpu.utils import pvary_over
+
+            # one reduce-scatter per leaf instead of an all-reduce: grads
+            # leave the program dp-SHARDED (1/dp per device), aligned
+            # leaf-for-leaf with the ZeRO-1-placed moments, so the
+            # optimizer update below runs fully local. The scatter dim is
+            # read off the spec itself — one encoding of the placement
+            # rule, no chance of divergence.
+            gspecs = zero2_grad_specs(self.params, mesh)
+            gdims = [next((i for i, ax in enumerate(sp) if ax == "dp"),
+                          None)
+                     for sp in jax.tree_util.tree_leaves(
+                         gspecs, is_leaf=lambda x: isinstance(x, P))]
+
+            @jax.jit
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P(), P("dp", "sp"), P("dp", "sp"), P()),
+                     out_specs=(P(), gspecs))
+            def _loss_grads(params, tokens, targets, step):
+                # pvary the params: cotangents then arrive as per-tile
+                # PARTIALS (no auto-psum), and the reduction is ours to
+                # place — psum_scatter over 'dp'
+                params_v = pvary_over(params, ("dp", "sp"))
+                key = train_key(step)
+
+                def scaled(p):
+                    return local_loss(p, tokens, targets, key) / n_tiles
+
+                lloc, grads = jax.value_and_grad(scaled)(params_v)
+                leaves, tdef = jax.tree_util.tree_flatten(grads)
+                out = []
+                for g, dim in zip(leaves, gdims):
+                    # unconditionally: even at sp=1 the pvaried grads are
+                    # TYPED sp-varying and need the (free) psum to retype
+                    g = jax.lax.psum(g, "sp")
+                    if dim is None:
+                        g = jax.lax.psum(g, "dp")
+                    else:
+                        g = jax.lax.psum_scatter(
+                            g, "dp", scatter_dimension=dim, tiled=True)
+                    out.append(g)
+                grads = jax.tree_util.tree_unflatten(tdef, out)
+                return (jax.lax.pmean(lloc * n_tiles, ("dp", "sp")),
+                        grads)
+
+            self.opt_state = shard_state_zero1(self.opt_state, mesh)
+            self._loss_grads_fn = _loss_grads
+            self._update_fn = make_zero1_update(
+                opt, self.params, self.opt_state)
+            self._step_fn = None
+        elif zero1:
             from shallowspeed_tpu.parallel.zero import (
                 make_zero1_update, shard_state_zero1)
 
             @jax.jit
             @partial(shard_map, mesh=mesh,
-                     in_specs=(P(), P("dp", "sp"), P("dp", "sp")),
+                     in_specs=(P(), P("dp", "sp"), P("dp", "sp"), P()),
                      out_specs=(P(), P()))
-            def _loss_grads(params, tokens, targets):
+            def _loss_grads(params, tokens, targets, step):
                 # ZeRO-1 grad program: the grads leave the shard_map
                 # already psum'd (invariant), ready for the dp-sharded
                 # optimizer update.
-                return loss_and_grads(params, tokens, targets)
+                return loss_and_grads(params, tokens, targets, step)
 
             self.opt_state = shard_state_zero1(self.opt_state, mesh)
             self._loss_grads_fn = _loss_grads
@@ -129,10 +200,11 @@ class ContextParallelEngine:
 
             @partial(jax.jit, donate_argnums=(0, 1))
             @partial(shard_map, mesh=mesh,
-                     in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
+                     in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp"),
+                               P()),
                      out_specs=(P(), P(), P()))
-            def _step(params, opt_state, tokens, targets):
-                loss, grads = loss_and_grads(params, tokens, targets)
+            def _step(params, opt_state, tokens, targets, step):
+                loss, grads = loss_and_grads(params, tokens, targets, step)
                 params, opt_state = opt.step(params, grads, opt_state)
                 return params, opt_state, loss
 
@@ -182,15 +254,18 @@ class ContextParallelEngine:
     def train_batch_async(self, tokens, targets) -> jax.Array:
         """One optimizer step; loss as a lazy device scalar (no host sync —
         `float()` it only at log points; see `data/prefetch.py`)."""
-        if self._step_fn is None:  # ZeRO-1: grad program + sharded update
+        step = np.uint32(self._step_count)
+        self._step_count += 1
+        if self._step_fn is None:  # ZeRO-1/2: grad program + sharded update
             loss, grads = self._loss_grads_fn(
-                self.params, self._place(tokens), self._place(targets))
+                self.params, self._place(tokens), self._place(targets),
+                step)
             self.params, self.opt_state = self._update_fn(
                 self.params, grads, self.opt_state)
             return loss
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state,
-            self._place(tokens), self._place(targets))
+            self._place(tokens), self._place(targets), step)
         return loss
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:

@@ -66,11 +66,11 @@ class FSDPEngine(GSPMDEngine):
     """
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0, zero1: bool = False):
-        if zero1:
+                 seed: int = 0, zero1: bool = False, zero2: bool = False):
+        if zero1 or zero2:
             raise ValueError(
                 "FSDP already shards the optimizer state (ZeRO-3 is a "
-                "superset of ZeRO-1); drop zero1=True")
+                "superset of ZeRO-1/2); drop zero1/zero2")
         super().__init__(cfg, optimizer, mesh, seed=seed, zero1=False)
 
     def validate(self, cfg: T.TransformerConfig, mesh: Mesh) -> None:

@@ -27,7 +27,8 @@ class GSPMDEngine:
     axis), parameters placed per `self.param_specs(cfg)`."""
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0, zero1: bool = False):
+                 seed: int = 0, zero1: bool = False, zero2: bool = False):
+        assert not (zero1 and zero2), "zero2 subsumes zero1"
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
@@ -38,6 +39,7 @@ class GSPMDEngine:
         # placements (FSDP) don't re-run it
         params_host = T.init(cfg, seed)
         self._params_host = params_host
+        self._step_count = 0
         self.shardings = tree_map(
             lambda s: NamedSharding(mesh, s), self.param_specs(cfg),
             is_leaf=lambda x: isinstance(x, P))
@@ -54,16 +56,37 @@ class GSPMDEngine:
 
         opt = optimizer
 
-        if zero1:
+        def train_key(step):
+            """Per-step dropout key (None when the config has no dropout,
+            keeping RNG out of the trace); deterministic in (seed, step)."""
+            if cfg.dropout == 0.0:
+                return None
+            return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+        if zero1 or zero2:
             from shallowspeed_tpu.parallel.zero import (
-                make_zero1_update, shard_state_zero1)
+                make_zero1_update, shard_state_zero1, zero2_grad_specs)
 
             self.opt_state = shard_state_zero1(self.opt_state, mesh)
 
-            @jax.jit
-            def _grads(params, tokens, targets):
+            if zero2:
+                # pin the grad outputs dp-sharded: XLA's partial-sum
+                # propagation lowers the DP all-reduce to reduce-scatter
+                # and the persistent grad buffer is 1/dp per device,
+                # leaf-aligned with the ZeRO-1-placed moments
+                gshard = tree_map(
+                    lambda s: NamedSharding(mesh, s),
+                    zero2_grad_specs(self.params, mesh),
+                    is_leaf=lambda x: isinstance(x, P))
+                out_sh = (NamedSharding(mesh, P()), gshard)
+            else:
+                out_sh = None
+
+            @partial(jax.jit, out_shardings=out_sh)
+            def _grads(params, tokens, targets, step):
                 return jax.value_and_grad(
-                    lambda p: T.loss(p, tokens, targets, cfg))(params)
+                    lambda p: T.loss(p, tokens, targets, cfg,
+                                     dropout_key=train_key(step)))(params)
 
             self._grads_fn = _grads
             self._update_fn = make_zero1_update(
@@ -72,9 +95,10 @@ class GSPMDEngine:
         else:
 
             @partial(jax.jit, donate_argnums=(0, 1))
-            def _step(params, opt_state, tokens, targets):
+            def _step(params, opt_state, tokens, targets, step):
                 loss, grads = jax.value_and_grad(
-                    lambda p: T.loss(p, tokens, targets, cfg))(params)
+                    lambda p: T.loss(p, tokens, targets, cfg,
+                                     dropout_key=train_key(step)))(params)
                 params, opt_state = opt.step(params, grads, opt_state)
                 return params, opt_state, loss
 
@@ -132,15 +156,18 @@ class GSPMDEngine:
         """One optimizer step; the loss returns as a LAZY device scalar so
         the dispatch loop never blocks on it (callers `float()` only when
         they actually log — see `data/prefetch.py`)."""
-        if self._step_fn is None:  # ZeRO-1: grad program + sharded update
+        step = np.uint32(self._step_count)
+        self._step_count += 1
+        if self._step_fn is None:  # ZeRO-1/2: grad program + sharded update
             loss, grads = self._grads_fn(
-                self.params, self._place(tokens), self._place(targets))
+                self.params, self._place(tokens), self._place(targets),
+                step)
             self.params, self.opt_state = self._update_fn(
                 self.params, grads, self.opt_state)
             return loss
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state,
-            self._place(tokens), self._place(targets))
+            self._place(tokens), self._place(targets), step)
         return loss
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:

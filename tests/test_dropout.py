@@ -1,0 +1,223 @@
+"""Dropout (`models/transformer.py` `cfg.dropout`, `--dropout`).
+
+The reference has no regularization at all; this is the modern-framework
+staple, done the functional way: train/eval is a property of the CALL
+(key vs no key), never of mutable model state, and keys derive
+deterministically from (step, microbatch, layer, mesh position) — which
+makes masks reproducible under remat recompute and under the 1F1B
+schedule's per-tick vjp recompute (asserted below).
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.optim import SGD, Adam
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                          max_seq=32, dropout=0.2)
+CFG0 = replace(CFG, dropout=0.0)
+
+
+def mesh2(dp, m, name):
+    devs = np.array(jax.devices()[: dp * m]).reshape(dp, m)
+    return Mesh(devs, ("dp", name))
+
+
+def batch(seed=0, b=8, t=32, vocab=64):
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+# ------------------------------------------------------------- model level
+
+
+def test_no_key_means_no_dropout():
+    """Without a key the forward is the exact dropout=0 program."""
+    params = T.init(CFG, seed=0)
+    tok, _ = batch()
+    a = T.forward(params, tok, CFG)                       # no key
+    b_ = T.forward(params, tok, CFG0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_key_changes_output_and_is_deterministic():
+    params = T.init(CFG, seed=0)
+    tok, _ = batch()
+    k1, k2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+    y1 = T.forward(params, tok, CFG, dropout_key=k1)
+    y1b = T.forward(params, tok, CFG, dropout_key=k1)
+    y2 = T.forward(params, tok, CFG, dropout_key=k2)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y1b))
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+    assert not np.allclose(np.asarray(y1),
+                           np.asarray(T.forward(params, tok, CFG)))
+
+
+def test_dropout_zero_key_is_inert():
+    """dropout=0 with a key passed is still the deterministic program."""
+    params = T.init(CFG0, seed=0)
+    tok, _ = batch()
+    y = T.forward(params, tok, CFG0, dropout_key=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(y),
+                                  np.asarray(T.forward(params, tok, CFG0)))
+
+
+def test_remat_reproduces_masks():
+    """jax.checkpoint recompute must regenerate identical masks: the
+    remat and non-remat programs compute the same loss AND gradient."""
+    cfg_r = replace(CFG, remat=True)
+    params = jax.device_put(T.init(CFG, seed=0))
+    tok, tgt = batch()
+    key = jax.random.PRNGKey(5)
+
+    def loss_fn(cfg):
+        return jax.value_and_grad(
+            lambda p: T.loss(p, tok, tgt, cfg, dropout_key=key))(params)
+
+    l0, g0 = loss_fn(CFG)
+    l1, g1 = loss_fn(cfg_r)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g0),
+                     jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-7)
+
+
+# ------------------------------------------------------------ engine level
+
+
+def test_context_engine_dropout_trains_and_eval_is_clean():
+    eng = ContextParallelEngine(CFG, Adam(5e-3), mesh2(2, 2, "sp"), seed=0)
+    ref = ContextParallelEngine(CFG0, Adam(5e-3), mesh2(1, 1, "sp"), seed=0)
+    tok, tgt = batch(7)
+    # eval never drops: identical params => identical eval loss, and a
+    # training step with dropout differs from the dropout-free one
+    assert eng.eval_loss(tok, tgt) == pytest.approx(
+        ref.eval_loss(tok, tgt), rel=1e-5)
+    l_drop = eng.train_batch(tok, tgt)
+    l_ref = ref.train_batch(tok, tgt)
+    assert l_drop != pytest.approx(l_ref, rel=1e-6)
+    losses = [eng.train_batch(tok, tgt) for _ in range(30)]
+    assert losses[-1] < losses[0], losses[::10]
+
+
+def test_context_engine_dropout_deterministic_across_runs():
+    a = ContextParallelEngine(CFG, SGD(0.1), mesh2(2, 2, "sp"), seed=3)
+    b_ = ContextParallelEngine(CFG, SGD(0.1), mesh2(2, 2, "sp"), seed=3)
+    for s in range(3):
+        tok, tgt = batch(s)
+        assert a.train_batch(tok, tgt) == pytest.approx(
+            b_.train_batch(tok, tgt), rel=1e-7), s
+
+
+def test_steps_draw_different_masks():
+    """The per-step fold_in must vary the masks: two consecutive steps on
+    IDENTICAL data with SGD lr=0 give different losses iff masks moved."""
+    eng = ContextParallelEngine(CFG, SGD(0.0), mesh2(1, 1, "sp"), seed=0)
+    tok, tgt = batch(1)
+    l0 = eng.train_batch(tok, tgt)
+    l1 = eng.train_batch(tok, tgt)   # same params (lr=0), new step key
+    assert l0 != pytest.approx(l1, rel=1e-7)
+
+
+def test_tensor_engine_dropout_trains():
+    eng = TensorParallelEngine(CFG, Adam(5e-3), mesh2(2, 2, "tp"), seed=0)
+    tok, tgt = batch(9)
+    losses = [eng.train_batch(tok, tgt) for _ in range(30)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses[::10]
+
+
+def test_zero2_dropout_matches_dense_engine():
+    """Same mesh + same step keys: ZeRO-2 placement must not change the
+    dropout math (keys fold mesh coordinates, not placement)."""
+    dense = ContextParallelEngine(CFG, Adam(1e-2), mesh2(4, 1, "sp"),
+                                  seed=0)
+    z2 = ContextParallelEngine(CFG, Adam(1e-2), mesh2(4, 1, "sp"),
+                               seed=0, zero2=True)
+    for s in range(3):
+        tok, tgt = batch(s)
+        np.testing.assert_allclose(dense.train_batch(tok, tgt),
+                                   z2.train_batch(tok, tgt),
+                                   rtol=1e-4, atol=1e-6)
+
+
+# --------------------------------------------------------------- pipeline
+
+
+def test_pipeline_gpipe_1f1b_identical_masks():
+    """The flagship recompute test: GPipe (autodiff backward over saved
+    residuals) and 1F1B (per-tick vjp recompute from the stash) derive
+    dropout keys the same way, so with the same seed they must produce
+    the SAME losses and parameters — proving the 1F1B backward
+    regenerates bit-identical masks."""
+    g = PipelineLMEngine(replace(CFG, n_layers=4), SGD(0.1),
+                         Mesh(np.array(jax.devices()[:4]).reshape(1, 4),
+                              ("dp", "pp")),
+                         n_mubatches=4, seed=0, schedule="gpipe")
+    f = PipelineLMEngine(replace(CFG, n_layers=4), SGD(0.1),
+                         Mesh(np.array(jax.devices()[:4]).reshape(1, 4),
+                              ("dp", "pp")),
+                         n_mubatches=4, seed=0, schedule="1f1b")
+    for s in range(3):
+        tok, tgt = batch(s)
+        assert f.train_batch(tok, tgt) == pytest.approx(
+            g.train_batch(tok, tgt), rel=1e-5), s
+    for a, b_ in zip(jax.tree_util.tree_leaves(f.get_canonical_params()),
+                     jax.tree_util.tree_leaves(g.get_canonical_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_dropout_trains_with_tp():
+    cfg = replace(CFG, n_layers=2)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "tp"))
+    eng = PipelineLMEngine(cfg, Adam(5e-3), mesh, n_mubatches=2, seed=0)
+    tok, tgt = batch(11)
+    losses = [eng.train_batch(tok, tgt) for _ in range(20)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses[::5]
+
+
+def test_resume_continues_mask_stream(tmp_path):
+    """checkpoint.restore resumes the dropout step counter: a save/
+    restore/continue run must equal the uninterrupted run exactly."""
+    from shallowspeed_tpu import checkpoint
+
+    straight = ContextParallelEngine(CFG, Adam(1e-2), mesh2(2, 1, "sp"),
+                                     seed=0)
+    eng = ContextParallelEngine(CFG, Adam(1e-2), mesh2(2, 1, "sp"), seed=0)
+    losses_a = [straight.train_batch(*batch(s)) for s in range(4)]
+    for s in range(2):
+        eng.train_batch(*batch(s))
+    checkpoint.save(tmp_path, eng, 1)   # step index 1 just finished
+    eng2 = ContextParallelEngine(CFG, Adam(1e-2), mesh2(2, 1, "sp"),
+                                 seed=0)
+    assert checkpoint.restore(eng2, checkpoint.latest(tmp_path)) == 2
+    assert eng2._step_count == 2
+    for s in range(2, 4):
+        np.testing.assert_allclose(eng2.train_batch(*batch(s)),
+                                   losses_a[s], rtol=1e-6, atol=1e-7)
+
+
+def test_generate_never_drops():
+    """Decode path passes no key: two samples from the same prompt and
+    sampling seed are identical even with cfg.dropout > 0."""
+    from shallowspeed_tpu.models.generate import generate
+
+    params = jax.device_put(T.init(CFG, seed=0))
+    prompt = np.array([[5, 9, 2, 4]], np.int32)
+    a = generate(params, prompt, CFG, max_new=8, seed=1, temperature=1.0)
+    b_ = generate(params, prompt, CFG, max_new=8, seed=1, temperature=1.0)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))

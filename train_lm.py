@@ -29,6 +29,11 @@ def parse_args(argv=None):
                    help="pipeline-parallel degree: GPipe over transformer "
                         "blocks, backward schedule derived by autodiff "
                         "(needs n_layers %% pp == 0)")
+    p.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="compiled pipeline schedule: gpipe (autodiff "
+                        "backward) or 1f1b (PipeDream-Flush: bounded "
+                        "min(pp, n_mu) activation stash)")
     p.add_argument("--n-mubatches", type=int, default=4,
                    help="microbatches per batch in the pipeline (--pp > 1)")
     p.add_argument("--sp", type=int, default=1,
@@ -57,9 +62,10 @@ def parse_args(argv=None):
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--optimizer", default="adam",
-                   choices=["sgd", "momentum", "adam", "adamw"])
+                   choices=["sgd", "momentum", "adam", "adamw",
+                            "adafactor"])
     p.add_argument("--weight-decay", type=float, default=0.01,
-                   help="decoupled weight decay (adamw only)")
+                   help="decoupled weight decay (adamw/adafactor)")
     p.add_argument("--grad-clip", type=float, default=0.0,
                    help="global-norm gradient clipping (0 = off)")
     p.add_argument("--lr-schedule", default="constant",
@@ -78,6 +84,10 @@ def parse_args(argv=None):
                    help="rotary position embeddings (replaces the learned "
                         "absolute embedding; composes with every engine "
                         "and sequence sharding)")
+    p.add_argument("--dropout", type=float, default=0.0,
+                   help="dropout rate on embeddings and attention/FFN "
+                        "outputs (GPT-2 placement); active in training "
+                        "steps only — eval and decode never drop")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize each block's activations in the "
                         "backward (jax.checkpoint): ~1 extra forward of "
@@ -92,6 +102,10 @@ def parse_args(argv=None):
                    help="ZeRO-1: shard optimizer state over the dp axis "
                         "(1/dp per-device Adam moment footprint; GSPMD "
                         "derives the reduce/all-gather pattern)")
+    p.add_argument("--zero2", action="store_true",
+                   help="ZeRO-2: ZeRO-1 plus dp-sharded gradients — the "
+                        "DP reduction becomes a reduce-scatter and the "
+                        "persistent grad buffer is 1/dp per device")
     p.add_argument("--attn", default="ring",
                    choices=["ring", "ulysses", "ulysses-flash", "flash"],
                    help="attention substrate: ring (any --sp), ulysses "
@@ -176,16 +190,19 @@ def train(args) -> float:
                          f"(= max_seq)")
     composite = args.sp > 1 and args.tp > 1
     if args.pp > 1 and (args.sp > 1 or args.ep > 1 or args.experts
-                        or args.fsdp or args.zero1):
+                        or args.fsdp or args.zero1 or args.zero2):
         raise SystemExit("--pp composes with --dp and --tp only for now")
     if args.pp > 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with --pp "
                          "(the pipeline engine uses XLA attention)")
     if args.ep > 1 and args.tp > 1:
         raise SystemExit("--ep composes with --dp/--sp (not --tp)")
-    if args.fsdp and (args.ep > 1 or args.experts or args.zero1):
+    if args.fsdp and (args.ep > 1 or args.experts or args.zero1
+                      or args.zero2):
         raise SystemExit("--fsdp composes with --dp/--sp/--tp (and already "
-                         "subsumes --zero1; MoE uses --ep)")
+                         "subsumes --zero1/--zero2; MoE uses --ep)")
+    if args.zero1 and args.zero2:
+        raise SystemExit("--zero2 subsumes --zero1; pick one")
     if args.fsdp and (args.sp > 1 or args.tp > 1):
         composite = True  # ZeRO-3 on top of the 3-D mesh
     if (args.fsdp or args.tp > 1) and args.attn != "ring":
@@ -230,7 +247,8 @@ def train(args) -> float:
                             compute_dtype=jnp.bfloat16 if args.bf16 else None,
                             remat=args.remat, rope=args.rope,
                             norm=args.norm, ffn=args.ffn,
-                            n_kv_heads=args.kv_heads)
+                            n_kv_heads=args.kv_heads,
+                            dropout=args.dropout)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
@@ -239,7 +257,7 @@ def train(args) -> float:
         lr = SCHEDULES[args.lr_schedule](
             peak=args.lr, warmup=args.warmup_steps, total=args.steps)
     opt_kw = {"grad_clip": args.grad_clip or None}
-    if args.optimizer == "adamw":
+    if args.optimizer in ("adamw", "adafactor"):
         opt_kw["weight_decay"] = args.weight_decay
     opt = OPTIMIZERS[args.optimizer](lr=lr, **opt_kw)
     devs = np.array(jax.devices()[: args.dp * model_par])
@@ -253,14 +271,16 @@ def train(args) -> float:
             mesh = Mesh(devs.reshape(args.dp, args.pp), ("dp", "pp"))
         engine = PipelineLMEngine(cfg, opt, mesh,
                                   n_mubatches=args.n_mubatches,
-                                  seed=args.seed)
+                                  seed=args.seed,
+                                  schedule=args.pp_schedule)
     elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.sp, args.tp),
                     ("dp", "sp", "tp"))
         engine = Composite3DEngine(cfg, opt, mesh, seed=args.seed,
-                                   zero1=args.zero1, fsdp=args.fsdp)
+                                   zero1=args.zero1, zero2=args.zero2,
+                                   fsdp=args.fsdp)
     elif args.fsdp:
         from shallowspeed_tpu.parallel.fsdp import FSDPEngine
 
@@ -275,17 +295,18 @@ def train(args) -> float:
         else:
             mesh = Mesh(devs.reshape(args.dp, args.ep), ("dp", "ep"))
         engine = ExpertParallelEngine(cfg, opt, mesh, seed=args.seed,
-                                      zero1=args.zero1)
+                                      zero1=args.zero1, zero2=args.zero2)
     elif args.tp > 1:
         from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.tp), ("dp", "tp"))
         engine = TensorParallelEngine(cfg, opt, mesh, seed=args.seed,
-                                      zero1=args.zero1)
+                                      zero1=args.zero1, zero2=args.zero2)
     else:
         mesh = Mesh(devs.reshape(args.dp, args.sp), ("dp", "sp"))
         engine = ContextParallelEngine(cfg, opt, mesh, seed=args.seed,
-                                       attn=args.attn, zero1=args.zero1)
+                                       attn=args.attn, zero1=args.zero1,
+                                       zero2=args.zero2)
 
     start_step = 0
     if args.resume or args.sample_only:  # save-dir presence checked early
