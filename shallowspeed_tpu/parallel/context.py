@@ -36,6 +36,9 @@ except ImportError:  # pragma: no cover
 
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.ops.attention import ring_attention, ulysses_attention
+from shallowspeed_tpu.utils import pvary_over
+
+tree_map = jax.tree_util.tree_map
 
 
 class ContextParallelEngine:
@@ -57,9 +60,11 @@ class ContextParallelEngine:
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  seed: int = 0, attn: str = "ring", zero1: bool = False,
-                 zero2: bool = False):
+                 zero2: bool = False, accum: int = 1):
         assert mesh.axis_names == ("dp", "sp")
         assert not (zero1 and zero2), "zero2 subsumes zero1"
+        assert accum >= 1, accum
+        self.accum = accum
         self.cfg = cfg
         self.mesh = mesh
         self.dp, self.sp = mesh.devices.shape
@@ -106,28 +111,76 @@ class ContextParallelEngine:
             return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 
         n_tiles = self.dp * self.sp
+        accum = self.accum
+
+        def partial_grad_sum(params_v, tokens, targets, key):
+            """Gradient accumulation: scan `accum` microbatches of the
+            local tile, each doing its own forward AND backward (the
+            standard JAX pattern — no cross-iteration residuals, so
+            activation memory is one microbatch's worth regardless of
+            accum). `params_v` must be pvaried so per-microbatch
+            cotangents stay UNREDUCED per-tile partials; the caller
+            places the single cross-tile reduction after the scan.
+            Returns (loss sum over microbatches, grad sum)."""
+            b, t = tokens.shape
+            assert b % accum == 0, (
+                f"--accum {accum} must divide the per-device batch rows "
+                f"({b} here = batch / (dp * sp))")
+            tok_r = tokens.reshape(accum, b // accum, t)
+            tgt_r = targets.reshape(accum, b // accum, t)
+
+            def body(carry, xs):
+                mu, tok_mu, tgt_mu = xs
+                k_mu = (None if key is None
+                        else jax.random.fold_in(key, mu))
+                l, g = jax.value_and_grad(
+                    lambda p: local_loss(p, tok_mu, tgt_mu, k_mu))(
+                        params_v)
+                return (carry[0] + l,
+                        tree_map(jnp.add, carry[1], g)), None
+
+            init = pvary_over(
+                (jnp.float32(0.0),
+                 tree_map(lambda l: jnp.zeros_like(l, jnp.float32),
+                          params_v)),
+                ("dp", "sp"))
+            (loss_sum, gsum), _ = jax.lax.scan(
+                body, init, (jnp.arange(accum), tok_r, tgt_r))
+            return loss_sum, gsum
+
+        def tile_loss_and_gsum(params_v, tokens, targets, key):
+            """(pmean'd global loss, UNREDUCED per-tile gradient sum,
+            scale to apply after the cross-tile reduction) — the single
+            encoding of the loss/grad scaling, shared by the dense,
+            ZeRO-1, and ZeRO-2 gradient programs; each places its own
+            reduction (psum vs psum_scatter) on the returned sum. The
+            global-mean gradient falls out because every tile and every
+            microbatch is equal-sized (mean of means is exact — the
+            reference's own scaling invariant, `functional.py:43-44`;
+            its interleaved Iallreduce, `pipe.py:302-327`, is here a
+            single compiled reduction)."""
+            if accum == 1:
+                lloc, gsum = jax.value_and_grad(
+                    lambda p: local_loss(p, tokens, targets, key))(
+                        params_v)
+                return (jax.lax.pmean(lloc, ("dp", "sp")), gsum,
+                        1.0 / n_tiles)
+            loss_sum, gsum = partial_grad_sum(params_v, tokens, targets,
+                                              key)
+            return (jax.lax.pmean(loss_sum / accum, ("dp", "sp")), gsum,
+                    1.0 / (n_tiles * accum))
 
         def loss_and_grads(params, tokens, targets, step):
-            # Params are mesh-invariant (replicated), the per-tile loss is
-            # varying: jax.grad's transpose of that broadcast IS a psum over
-            # ('dp','sp') — the gradient arrives already summed across tiles.
-            # Scaling the local loss by 1/n_tiles therefore yields exactly
-            # the global-mean gradient (equal tiles => mean of means), with
-            # the DP all-reduce emitted by autodiff instead of hand-placed
-            # (the XLA-native version of the reference's interleaved
-            # Iallreduce, `pipe.py:302-327`).
             key = train_key(step)
-
-            def scaled(p):
-                return local_loss(p, tokens, targets, key) / n_tiles
-
-            lloc, grads = jax.value_and_grad(scaled)(params)
-            return jax.lax.pmean(lloc * n_tiles, ("dp", "sp")), grads
+            loss, gsum, scale = tile_loss_and_gsum(
+                pvary_over(params, ("dp", "sp")), tokens, targets, key)
+            grads = tree_map(
+                lambda g: jax.lax.psum(g, ("dp", "sp")) * scale, gsum)
+            return loss, grads
 
         if zero2:
             from shallowspeed_tpu.parallel.zero import (
                 make_zero1_update, shard_state_zero1, zero2_grad_specs)
-            from shallowspeed_tpu.utils import pvary_over
 
             # one reduce-scatter per leaf instead of an all-reduce: grads
             # leave the program dp-SHARDED (1/dp per device), aligned
@@ -149,13 +202,10 @@ class ContextParallelEngine:
                 # pvary the params: cotangents then arrive as per-tile
                 # PARTIALS (no auto-psum), and the reduction is ours to
                 # place — psum_scatter over 'dp'
-                params_v = pvary_over(params, ("dp", "sp"))
                 key = train_key(step)
-
-                def scaled(p):
-                    return local_loss(p, tokens, targets, key) / n_tiles
-
-                lloc, grads = jax.value_and_grad(scaled)(params_v)
+                loss, grads, gscale = tile_loss_and_gsum(
+                    pvary_over(params, ("dp", "sp")), tokens, targets,
+                    key)
                 leaves, tdef = jax.tree_util.tree_flatten(grads)
                 out = []
                 for g, dim in zip(leaves, gdims):
@@ -167,10 +217,9 @@ class ContextParallelEngine:
                     else:
                         g = jax.lax.psum_scatter(
                             g, "dp", scatter_dimension=dim, tiled=True)
-                    out.append(g)
+                    out.append(g * gscale)
                 grads = jax.tree_util.tree_unflatten(tdef, out)
-                return (jax.lax.pmean(lloc * n_tiles, ("dp", "sp")),
-                        grads)
+                return loss, grads
 
             self.opt_state = shard_state_zero1(self.opt_state, mesh)
             self._loss_grads_fn = _loss_grads

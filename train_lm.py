@@ -129,6 +129,11 @@ def parse_args(argv=None):
                         "checkpoint (implies --resume) and just --generate")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient accumulation: split each batch into N "
+                        "sequential microbatches per device (activation "
+                        "memory of one microbatch, same gradient); plain "
+                        "dp/sp engine only")
     p.add_argument("--prefetch", type=int, default=2,
                    help="input-pipeline depth: batches built + placed on "
                         "device this many steps ahead on a background "
@@ -203,6 +208,11 @@ def train(args) -> float:
                          "subsumes --zero1/--zero2; MoE uses --ep)")
     if args.zero1 and args.zero2:
         raise SystemExit("--zero2 subsumes --zero1; pick one")
+    if args.accum > 1 and (args.tp > 1 or args.ep > 1 or args.experts
+                           or args.fsdp or args.pp > 1):
+        raise SystemExit("--accum composes with --dp/--sp (the context "
+                         "engine) for now; the pipeline engine already "
+                         "microbatches via --n-mubatches")
     if args.fsdp and (args.sp > 1 or args.tp > 1):
         composite = True  # ZeRO-3 on top of the 3-D mesh
     if (args.fsdp or args.tp > 1) and args.attn != "ring":
@@ -306,7 +316,7 @@ def train(args) -> float:
         mesh = Mesh(devs.reshape(args.dp, args.sp), ("dp", "sp"))
         engine = ContextParallelEngine(cfg, opt, mesh, seed=args.seed,
                                        attn=args.attn, zero1=args.zero1,
-                                       zero2=args.zero2)
+                                       zero2=args.zero2, accum=args.accum)
 
     start_step = 0
     if args.resume or args.sample_only:  # save-dir presence checked early
