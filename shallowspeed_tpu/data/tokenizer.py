@@ -1,0 +1,142 @@
+"""Byte-level BPE tokenizer — trained, saved, and loaded by the framework.
+
+The reference has no text pipeline at all (its data layer is MNIST
+vectors, `/root/reference/shallowspeed/dataset.py`); the LM driver here
+started byte-level (vocab 256). This module adds the standard subword
+step: byte-pair encoding over UTF-8 bytes (GPT-2's scheme, minus the
+regex pre-tokenizer — chunks split on whitespace with the space glued to
+the following word, so merges never cross word boundaries).
+
+Design points:
+- Base alphabet is all 256 bytes, so ANY input encodes losslessly and
+  decode is exact byte reconstruction — no <unk>, no normalization.
+- `train` counts pair frequencies over unique chunks (frequency-weighted),
+  merging the most frequent pair until `vocab_size`; pure NumPy/Python,
+  fine for the corpus sizes a single-host text file reaches.
+- `encode` caches per-chunk tokenizations, so repeated words cost one
+  merge pass; returns int32 ids ready for the LM engines.
+- Persistence is one JSON file (the merge list) — saved next to
+  checkpoints so `--sample-only` restores text fidelity with the model.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import numpy as np
+
+_CHUNK = re.compile(rb"\s*\S+|\s+")
+
+
+def _chunks(data: bytes) -> list[bytes]:
+    return _CHUNK.findall(data)
+
+
+class ByteBPE:
+    """Byte-level BPE: ids 0..255 are raw bytes, id 256+i is merge i."""
+
+    def __init__(self, merges: list[tuple[int, int]]):
+        self.merges = [tuple(m) for m in merges]
+        self._rank = {pair: i for i, pair in enumerate(self.merges)}
+        # id -> bytes it expands to (built up in merge order)
+        self._bytes = [bytes([i]) for i in range(256)]
+        for a, b in self.merges:
+            self._bytes.append(self._bytes[a] + self._bytes[b])
+        self._cache: dict[bytes, list[int]] = {}
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges)
+
+    # ------------------------------------------------------------ encode
+
+    def _merge_chunk(self, chunk: bytes) -> list[int]:
+        ids = list(chunk)
+        while len(ids) > 1:
+            best, best_rank = None, None
+            for pair in zip(ids, ids[1:]):
+                r = self._rank.get(pair)
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = pair, r
+            if best is None:
+                break
+            new_id = 256 + best_rank
+            out, i = [], 0
+            while i < len(ids):
+                if (i + 1 < len(ids)
+                        and (ids[i], ids[i + 1]) == best):
+                    out.append(new_id)
+                    i += 2
+                else:
+                    out.append(ids[i])
+                    i += 1
+            ids = out
+        return ids
+
+    def encode(self, text) -> np.ndarray:
+        data = text.encode() if isinstance(text, str) else bytes(text)
+        out: list[int] = []
+        for chunk in _chunks(data):
+            got = self._cache.get(chunk)
+            if got is None:
+                got = self._merge_chunk(chunk)
+                self._cache[chunk] = got
+            out.extend(got)
+        return np.asarray(out, np.int32)
+
+    def decode(self, ids) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
+
+    def decode_bytes(self, ids) -> bytes:
+        return b"".join(self._bytes[int(i)] for i in np.asarray(ids).ravel())
+
+    # ------------------------------------------------------- persistence
+
+    def save(self, path) -> None:
+        Path(path).write_text(json.dumps(
+            {"kind": "byte_bpe", "merges": self.merges}))
+
+    @classmethod
+    def load(cls, path) -> "ByteBPE":
+        head = json.loads(Path(path).read_text())
+        assert head.get("kind") == "byte_bpe", head.get("kind")
+        return cls([tuple(m) for m in head["merges"]])
+
+
+def train_bpe(text, vocab_size: int) -> ByteBPE:
+    """Train a ByteBPE to `vocab_size` (>= 256) on `text` (str or bytes).
+
+    Frequency-weighted over unique whitespace chunks: pair counts and
+    merges run over the (chunk -> count) table, not the raw stream, so
+    cost scales with vocabulary richness rather than corpus length.
+    Stops early if no pair repeats."""
+    assert vocab_size >= 256, vocab_size
+    data = text.encode() if isinstance(text, str) else bytes(text)
+    counts: dict[bytes, int] = {}
+    for c in _chunks(data):
+        counts[c] = counts.get(c, 0) + 1
+    words = [(list(c), n) for c, n in counts.items()]
+
+    merges: list[tuple[int, int]] = []
+    while 256 + len(merges) < vocab_size:
+        pair_counts: dict[tuple[int, int], int] = {}
+        for ids, n in words:
+            for pair in zip(ids, ids[1:]):
+                pair_counts[pair] = pair_counts.get(pair, 0) + n
+        if not pair_counts:
+            break
+        best, freq = max(pair_counts.items(), key=lambda kv: kv[1])
+        if freq < 2:
+            break  # nothing repeats; further merges are memorization
+        new_id = 256 + len(merges)
+        merges.append(best)
+        for ids, _ in words:
+            i = 0
+            while i < len(ids) - 1:
+                if (ids[i], ids[i + 1]) == best:
+                    ids[i:i + 2] = [new_id]
+                else:
+                    i += 1
+    return ByteBPE(merges)

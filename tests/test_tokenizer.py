@@ -1,0 +1,103 @@
+"""Byte-level BPE tokenizer (`data/tokenizer.py`, `--tokenizer bpe`).
+
+Contracts: lossless roundtrip on ANY bytes (base alphabet is all 256
+bytes — no <unk>), real compression on repetitive text, deterministic
+training, JSON save/load identity, and driver integration (vocab feeds
+the model config; sampling decodes through the tokenizer).
+"""
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.data.tokenizer import ByteBPE, train_bpe
+
+CORPUS = ("the quick brown fox jumps over the lazy dog. "
+          "the quick brown fox jumps again and again. " * 20)
+
+
+def test_roundtrip_identity():
+    tok = train_bpe(CORPUS, 300)
+    ids = tok.encode(CORPUS)
+    assert tok.decode(ids) == CORPUS
+    # arbitrary bytes (invalid UTF-8 included) survive encode/decode
+    blob = bytes(range(256)) * 3
+    assert tok.decode_bytes(tok.encode(blob)) == blob
+
+
+def test_compresses_repetitive_text():
+    tok = train_bpe(CORPUS, 500)
+    n_bytes = len(CORPUS.encode())
+    n_ids = len(tok.encode(CORPUS))
+    assert n_ids < 0.5 * n_bytes, (n_ids, n_bytes)
+    assert 256 < tok.vocab_size <= 500
+
+
+def test_merges_never_cross_whitespace():
+    tok = train_bpe("aa aa aa aa bb bb bb bb", 300)
+    for a, b in tok.merges:
+        merged = tok._bytes[a] + tok._bytes[b]
+        # a merge may START with the glued-on space but never contain an
+        # interior space (chunks end at whitespace boundaries)
+        assert b" " not in merged.lstrip(b" "), merged
+
+
+def test_training_deterministic():
+    a = train_bpe(CORPUS, 400)
+    b = train_bpe(CORPUS, 400)
+    assert a.merges == b.merges
+
+
+def test_stops_when_nothing_repeats():
+    tok = train_bpe("abcdefg", 10_000)
+    assert tok.vocab_size < 300  # no pair repeats twice -> early stop
+
+
+def test_save_load_roundtrip(tmp_path):
+    tok = train_bpe(CORPUS, 400)
+    tok.save(tmp_path / "tok.json")
+    tok2 = ByteBPE.load(tmp_path / "tok.json")
+    assert tok2.merges == tok.merges
+    ids = tok.encode("the quick brown fox")
+    np.testing.assert_array_equal(ids, tok2.encode("the quick brown fox"))
+
+
+def test_encode_returns_int32():
+    tok = train_bpe(CORPUS, 300)
+    ids = tok.encode("hello world")
+    assert ids.dtype == np.int32
+
+
+# ------------------------------------------------------ driver integration
+
+
+def test_driver_trains_and_samples_with_bpe(tmp_path):
+    import train_lm
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(CORPUS)
+    args = train_lm.parse_args([
+        "--text", str(corpus), "--tokenizer", "bpe", "--vocab-size", "400",
+        "--steps", "8", "--seq-len", "32", "--d-model", "32",
+        "--batch-size", "4", "--log-every", "4", "--prefetch", "0",
+        "--save-dir", str(tmp_path / "ck"), "--save-every", "4",
+        "--generate", "8", "--prompt", "the quick",
+    ])
+    loss = train_lm.train(args)
+    assert np.isfinite(loss)
+    assert (tmp_path / "ck" / "tokenizer.json").exists()
+
+    # sample-only restores the tokenizer (and the vocab it implies)
+    args2 = train_lm.parse_args([
+        "--tokenizer", "bpe", "--seq-len", "32", "--d-model", "32",
+        "--save-dir", str(tmp_path / "ck"), "--sample-only",
+        "--prompt", "the quick", "--generate", "8", "--prefetch", "0",
+    ])
+    assert np.isnan(train_lm.train(args2))
+
+
+def test_driver_bpe_without_text_rejected():
+    import train_lm
+
+    args = train_lm.parse_args(["--tokenizer", "bpe", "--steps", "2"])
+    with pytest.raises(SystemExit, match="bpe needs --text"):
+        train_lm.train(args)

@@ -115,7 +115,14 @@ def parse_args(argv=None):
                         "with --tp/--fsdp the GSPMD engines use XLA "
                         "attention (K/V all-gather under --sp)")
     p.add_argument("--text", type=str, default="",
-                   help="train on this UTF-8 text file (byte-level vocab)")
+                   help="train on this UTF-8 text file (byte-level vocab, "
+                        "or subword with --tokenizer bpe)")
+    p.add_argument("--tokenizer", default="byte", choices=["byte", "bpe"],
+                   help="text tokenization: raw bytes (vocab 256) or "
+                        "byte-level BPE trained on --text to --vocab-size "
+                        "(saved/restored with --save-dir)")
+    p.add_argument("--vocab-size", type=int, default=512,
+                   help="BPE target vocabulary (--tokenizer bpe)")
     p.add_argument("--generate", type=int, default=0,
                    help="after training, sample this many tokens from the "
                         "model (KV-cache decode) and print them")
@@ -153,6 +160,68 @@ def parse_args(argv=None):
                    choices=["cpu", "tpu"])
     p.add_argument("--host-devices", type=int, default=0)
     return p.parse_args(argv)
+
+
+def prepare_text(args):
+    """(vocab, tokenizer, train ids, val ids) for the configured text
+    pipeline. Byte mode: ids ARE the bytes (vocab 256, tokenizer None).
+    BPE mode: train a ByteBPE on the training split (or load the one
+    saved next to the checkpoints — --resume/--sample-only restore text
+    fidelity with the model), then encode each split. Runs before the
+    model config is built because the tokenizer defines the vocab."""
+    from pathlib import Path
+
+    tokenizer = None
+    text_data = val_data = None
+    train_bytes = val_bytes = None
+    if args.text:
+        raw = open(args.text, "rb").read()
+        assert len(raw) > args.seq_len + 1, "text too short for --seq-len"
+        if args.val_every:
+            split = max(int(len(raw) * 0.9), args.seq_len + 2)
+            train_bytes, val_bytes = raw[:split], raw[split:]
+            assert len(val_bytes) > args.seq_len + 1, (
+                "text too short to hold out a 10% validation tail")
+        else:
+            train_bytes = raw
+
+    if args.tokenizer == "bpe":
+        from shallowspeed_tpu.data.tokenizer import ByteBPE, train_bpe
+
+        tok_path = (Path(args.save_dir) / "tokenizer.json"
+                    if args.save_dir else None)
+        reuse = args.resume or args.sample_only
+        if reuse and tok_path is not None and tok_path.exists():
+            # resuming: the checkpointed weights are bound to the saved
+            # merges — restore them and ignore --vocab-size. A FRESH run
+            # always retrains (and overwrites), so a stale tokenizer.json
+            # can never silently pin a new run's vocabulary.
+            tokenizer = ByteBPE.load(tok_path)
+        elif train_bytes is not None:
+            tokenizer = train_bpe(train_bytes, args.vocab_size)
+            if tok_path is not None:
+                tok_path.parent.mkdir(parents=True, exist_ok=True)
+                tokenizer.save(tok_path)
+        else:
+            raise SystemExit("--tokenizer bpe needs --text to train on "
+                             "(or a tokenizer.json under --save-dir)")
+        vocab = tokenizer.vocab_size
+        if train_bytes is not None:
+            text_data = tokenizer.encode(train_bytes)
+            assert len(text_data) > args.seq_len + 1, (
+                "tokenized text too short for --seq-len")
+        if val_bytes is not None:
+            val_data = tokenizer.encode(val_bytes)
+            assert len(val_data) > args.seq_len + 1, (
+                "tokenized validation tail too short for --seq-len")
+    else:
+        vocab = 256
+        if train_bytes is not None:
+            text_data = np.frombuffer(train_bytes, np.uint8).astype(
+                np.int32)
+        if val_bytes is not None:
+            val_data = np.frombuffer(val_bytes, np.uint8).astype(np.int32)
+    return vocab, tokenizer, text_data, val_data
 
 
 def make_batch(args, vocab, step: int, text_data=None):
@@ -247,7 +316,7 @@ def train(args) -> float:
     assert args.batch_size % args.dp == 0
     assert args.seq_len % args.sp == 0
 
-    vocab = 256
+    vocab, tokenizer, text_data, val_data = prepare_text(args)
     import jax.numpy as jnp
 
     cfg = TransformerConfig(vocab=vocab, d_model=args.d_model,
@@ -334,19 +403,6 @@ def train(args) -> float:
     metrics = MetricsLogger(args.log_file, dp=args.dp, sp=args.sp,
                             seq_len=args.seq_len, d_model=args.d_model,
                             n_layers=args.n_layers)
-    text_data = val_data = None
-    if args.text:
-        raw = np.frombuffer(
-            open(args.text, "rb").read(), np.uint8).astype(np.int32)
-        assert len(raw) > args.seq_len + 1, "text too short for --seq-len"
-        if args.val_every:
-            split = max(int(len(raw) * 0.9), args.seq_len + 2)
-            text_data, val_data = raw[:split], raw[split:]
-            assert len(val_data) > args.seq_len + 1, (
-                "text too short to hold out a 10% validation tail")
-        else:
-            text_data = raw
-
     n_evals = 0
 
     def val_loss() -> float:
@@ -363,7 +419,7 @@ def train(args) -> float:
         return float(engine.eval_loss(local_rows(tok), local_rows(tgt)))
 
     if args.sample_only:
-        sample_and_print(args, engine, cfg, vocab, text_data)
+        sample_and_print(args, engine, cfg, vocab, text_data, tokenizer)
         return float("nan")
 
     t0 = time.time()
@@ -444,30 +500,37 @@ def train(args) -> float:
             placed.close()
 
     if args.generate > 0:
-        sample_and_print(args, engine, cfg, vocab, text_data)
+        sample_and_print(args, engine, cfg, vocab, text_data, tokenizer)
     return loss
 
 
-def sample_and_print(args, engine, cfg, vocab, text_data):
-    """KV-cache decode from the trained/restored model: --prompt bytes or
-    a 16-token prefix from the data stream."""
+def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
+    """KV-cache decode from the trained/restored model: --prompt (bytes,
+    or BPE ids with --tokenizer bpe) or a 16-token data-stream prefix."""
     from shallowspeed_tpu.models.generate import generate
     from shallowspeed_tpu.utils import rprint
 
     # length already validated fail-fast at argument-checking time
-    # (--prompt/--sample-only force args.generate to be set there)
+    # (--prompt/--sample-only force args.generate to be set there;
+    # byte count upper-bounds the BPE token count, so the check holds)
     if args.prompt:
-        prompt = np.frombuffer(args.prompt.encode(), np.uint8).astype(
-            np.int32)[None, :]
+        if tokenizer is not None:
+            prompt = tokenizer.encode(args.prompt)[None, :]
+        else:
+            prompt = np.frombuffer(args.prompt.encode(), np.uint8).astype(
+                np.int32)[None, :]
     else:
         prompt, _ = make_batch(args, vocab, 0, text_data)
         prompt = prompt[:1, :16]  # one row, short prefix
     out = np.asarray(generate(
         engine.get_canonical_params(), prompt, cfg, args.generate,
         temperature=args.temperature, top_k=args.top_k, seed=args.seed))
-    body = bytes(int(x) for x in out[0])
-    rprint(f"prompt: {bytes(int(x) for x in prompt[0])!r}")
-    rprint(f"sample: {body!r}")
+    if tokenizer is not None:
+        rprint(f"prompt: {tokenizer.decode_bytes(prompt[0])!r}")
+        rprint(f"sample: {tokenizer.decode_bytes(out[0])!r}")
+    else:
+        rprint(f"prompt: {bytes(int(x) for x in prompt[0])!r}")
+        rprint(f"sample: {bytes(int(x) for x in out[0])!r}")
 
 
 if __name__ == "__main__":
