@@ -112,7 +112,7 @@ def prefill(params, tokens, cfg: T.TransformerConfig, cache):
                 cache[i]["v"], v.astype(cache[i]["v"].dtype), 0, axis=1),
         }
     x = T._norm(params["ln_f"], x, cfg)
-    logits = T._dense(params["head"], x[:, tp - 1])
+    logits = T.head_logits(params, x[:, tp - 1], cfg)
     return logits.astype(jnp.float32), cache
 
 
@@ -129,7 +129,7 @@ def decode_step(params, token, pos, cache, cfg: T.TransformerConfig):
         x, cblk = _block_decode(blk, x, cfg, cblk, pos)
         new_cache.append(cblk)
     x = T._norm(params["ln_f"], x, cfg)
-    logits = T._dense(params["head"], x[:, 0])
+    logits = T.head_logits(params, x[:, 0], cfg)
     return logits.astype(jnp.float32), new_cache
 
 

@@ -83,6 +83,14 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 1e-2
+    # Weight tying (Press & Wolf): the output head reuses tok_emb^T
+    # instead of its own (vocab, d) matrix — the params pytree simply has
+    # no "head" entry, so every engine's placement/checkpoint logic stays
+    # structural. Standard for small/medium LMs; halves embedding memory.
+    tie_embeddings: bool = False
+    # Label smoothing (Szegedy et al.): mix the one-hot target with the
+    # uniform distribution — loss = (1-ls)*NLL + ls*mean(-logp).
+    label_smoothing: float = 0.0
     # Dropout rate on the embedding sum, each attention output, and each
     # FFN output (GPT-2 placement; attention-probability dropout is
     # deliberately omitted — it would not compose with the fused
@@ -99,6 +107,7 @@ class TransformerConfig:
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
         assert self.ffn in ("gelu", "swiglu"), self.ffn
         assert 0.0 <= self.dropout < 1.0, self.dropout
+        assert 0.0 <= self.label_smoothing < 1.0, self.label_smoothing
         assert self.n_kv_heads >= 0, (
             f"n_kv_heads must be non-negative, got {self.n_kv_heads}")
         assert self.n_heads % self.kv_heads == 0, (
@@ -158,13 +167,15 @@ def init(cfg: TransformerConfig, seed: int = 0):
             blk["up"] = _dense_init(rng, d, 4 * d, dt)
             blk["down"] = _dense_init(rng, 4 * d, d, dt)
         blocks.append(blk)
-    return {
+    out = {
         "tok_emb": rng.normal(0.0, 0.02, (cfg.vocab, d)).astype(dt),
         "pos_emb": rng.normal(0.0, 0.02, (cfg.max_seq, d)).astype(dt),
         "blocks": blocks,
         "ln_f": {"g": np.ones((d,), dt), "b": np.zeros((d,), dt)},
-        "head": _dense_init(rng, d, cfg.vocab, dt),
     }
+    if not cfg.tie_embeddings:
+        out["head"] = _dense_init(rng, d, cfg.vocab, dt)
+    return out
 
 
 def cast_params(params, compute_dtype):
@@ -215,6 +226,30 @@ def _dropout(x, rate: float, key):
     keep = 1.0 - rate
     mask = jax.random.bernoulli(key, keep, x.shape)
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def head_logits(params, x, cfg: TransformerConfig):
+    """Vocabulary projection: the untied head, or tok_emb^T when
+    cfg.tie_embeddings (no bias — the tied head has none)."""
+    if cfg.tie_embeddings:
+        return x @ params["tok_emb"].T
+    return _dense(params["head"], x)
+
+
+def token_loss(logits, targets, cfg: TransformerConfig,
+               train: bool = True):
+    """Mean token cross-entropy in float32, with optional label
+    smoothing. THE loss every engine computes (the pipeline engines call
+    it per microbatch), so smoothing/vocab changes happen in one place.
+    Smoothing is a TRAINING regularizer: eval paths pass train=False so
+    reported val loss/perplexity stays the plain NLL, comparable across
+    runs regardless of --label-smoothing."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ls = cfg.label_smoothing
+    if train and ls > 0.0:
+        nll = (1.0 - ls) * nll + ls * (-logp.mean(axis=-1))
+    return nll.mean()
 
 
 def rope_rotate(x, pos, theta: float = 10000.0):
@@ -347,7 +382,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
         x, aux = block_fn(blk, x, cfg, attn_fn, False, pos, k_i)
         aux_total = aux_total + aux
     x = _norm(params["ln_f"], x, cfg)
-    return _dense(params["head"], x), aux_total
+    return head_logits(params, x, cfg), aux_total
 
 
 def forward(params, tokens, cfg: TransformerConfig,
@@ -358,7 +393,7 @@ def forward(params, tokens, cfg: TransformerConfig,
 
 
 def loss(params, tokens, targets, cfg: TransformerConfig,
-         attn_fn=None, pos_offset=0, dropout_key=None):
+         attn_fn=None, pos_offset=0, dropout_key=None, train: bool = True):
     """Mean softmax cross-entropy over all (batch, seq) positions, plus the
     weighted MoE load-balancing aux loss when the config has experts.
 
@@ -368,6 +403,5 @@ def loss(params, tokens, targets, cfg: TransformerConfig,
     """
     logits, aux = forward_with_aux(params, tokens, cfg, attn_fn, pos_offset,
                                    dropout_key)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean() + cfg.moe_aux_weight * aux
+    return (token_loss(logits, targets, cfg, train)
+            + cfg.moe_aux_weight * aux)

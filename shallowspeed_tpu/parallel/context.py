@@ -93,7 +93,7 @@ class ContextParallelEngine:
 
         sp = self.sp
 
-        def local_loss(params, tokens, targets, key=None):
+        def local_loss(params, tokens, targets, key=None, train=True):
             t_local = tokens.shape[1]
             off = jax.lax.axis_index("sp") * t_local
             if key is not None:
@@ -103,7 +103,8 @@ class ContextParallelEngine:
                     key, jax.lax.axis_index("dp") * sp
                     + jax.lax.axis_index("sp"))
             return T.loss(params, tokens, targets, cfg,
-                          attn_fn=attn, pos_offset=off, dropout_key=key)
+                          attn_fn=attn, pos_offset=off, dropout_key=key,
+                          train=train)
 
         def train_key(step):
             if cfg.dropout == 0.0:
@@ -265,7 +266,8 @@ class ContextParallelEngine:
                  out_specs=P())
         def _eval(params, tokens, targets):
             return jax.lax.pmean(
-                local_loss(params, tokens, targets), ("dp", "sp"))
+                local_loss(params, tokens, targets, train=False),
+                ("dp", "sp"))
 
         @jax.jit
         @partial(shard_map, mesh=mesh,

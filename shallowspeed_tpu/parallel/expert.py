@@ -37,13 +37,15 @@ def param_specs(cfg: T.TransformerConfig) -> dict:
     attn_proj = ({"q": dense, "kv": dense} if cfg.gqa
                  else {"qkv": dense})
     block = {"ln1": ln, **attn_proj, "proj": dense, "ln2": ln, "moe": moe}
-    return {
+    out = {
         "tok_emb": P(),
         "pos_emb": P(),
         "blocks": [block for _ in range(cfg.n_layers)],
         "ln_f": ln,
-        "head": dense,
     }
+    if not cfg.tie_embeddings:
+        out["head"] = dense
+    return out
 
 
 class ExpertParallelEngine(GSPMDEngine):

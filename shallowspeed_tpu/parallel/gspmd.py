@@ -104,7 +104,7 @@ class GSPMDEngine:
 
             self._step_fn = _step
         self._eval_fn = jax.jit(
-            lambda p, tok, tgt: T.loss(p, tok, tgt, cfg))
+            lambda p, tok, tgt: T.loss(p, tok, tgt, cfg, train=False))
         self._logits_fn = jax.jit(
             lambda p, tok: T.forward(p, tok, cfg))
 

@@ -171,9 +171,10 @@ class PipelineLMEngine:
             blocks_spec = tree_map(lambda _: P("pp"), host["blocks"])
         self._pspecs = {
             "tok_emb": P(), "pos_emb": P(), "ln_f": {"g": P(), "b": P()},
-            "head": {"W": P(), "b": P()},
             "blocks": blocks_spec,
         }
+        if not cfg.tie_embeddings:
+            self._pspecs["head"] = {"W": P(), "b": P()}
         self.params = jax.device_put(
             host, tree_map(lambda s: NamedSharding(mesh, s), self._pspecs,
                            is_leaf=lambda x: isinstance(x, P)))
@@ -297,7 +298,7 @@ class PipelineLMEngine:
             k_emb = jax.random.fold_in(k, pp)  # stage ids are < pp
             return k_stage, k_emb
 
-        def local_loss(params, tokens, targets, key=None):
+        def local_loss(params, tokens, targets, key=None, train=True):
             """Inside shard_map: tokens/targets (n_mu, mubs, T) local rows.
             Returns the global-mean NLL (invariant over the mesh)."""
             s = jax.lax.axis_index("pp")
@@ -322,11 +323,9 @@ class PipelineLMEngine:
                 h = apply_blocks(params["blocks"], x_in, k_stage)
                 # last stage: this microbatch's mean token NLL
                 hf = T._norm(params["ln_f"], h, cfg)
-                logits = T._dense(params["head"], hf).astype(jnp.float32)
+                logits = T.head_logits(params, hf, cfg)
                 tgt_m = jax.lax.dynamic_index_in_dim(targets, m, 0, False)
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                nll = -jnp.take_along_axis(
-                    logp, tgt_m[..., None], axis=-1)[..., 0].mean()
+                nll = T.token_loss(logits, tgt_m, cfg, train)
                 loss_acc = loss_acc + jnp.where(active & is_last, nll, 0.0)
                 nxt = jax.lax.ppermute(h, "pp", right)
                 return (nxt, loss_acc), None
@@ -398,10 +397,8 @@ class PipelineLMEngine:
             x = jnp.where(s == 0, x_own, x_in)
             h = apply_blocks(params_c["blocks"], x, k_stage)
             hf = T._norm(params_c["ln_f"], h, cfg)
-            logits = T._dense(params_c["head"], hf).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, tgt_m[..., None], axis=-1)[..., 0].mean()
+            nll = T.token_loss(T.head_logits(params_c, hf, cfg), tgt_m,
+                               cfg)
             return h, nll
 
         def local_1f1b(params, tokens, targets, key=None):
@@ -543,7 +540,7 @@ class PipelineLMEngine:
                  in_specs=(pspecs, P(None, "dp"), P(None, "dp")),
                  out_specs=P())
         def _eval(params, tokens, targets):
-            loss, _ = local_loss(params, tokens, targets)
+            loss, _ = local_loss(params, tokens, targets, train=False)
             return jax.lax.pmean(loss, "dp")
 
         self._step_fn = _step

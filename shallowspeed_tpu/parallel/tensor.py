@@ -47,13 +47,15 @@ def param_specs(cfg: T.TransformerConfig) -> dict:
         # SwiGLU's gate is column-parallel like up: the elementwise
         # silu(gate) * up then stays local to each tp shard
         block = {**block, "gate": col}
-    return {
+    out = {
         "tok_emb": P(),
         "pos_emb": P(),
         "blocks": [block for _ in range(cfg.n_layers)],
         "ln_f": ln,
-        "head": col,
     }
+    if not cfg.tie_embeddings:
+        out["head"] = col
+    return out
 
 
 class TensorParallelEngine(GSPMDEngine):

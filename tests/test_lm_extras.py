@@ -1,0 +1,168 @@
+"""Weight tying (`cfg.tie_embeddings`) and label smoothing
+(`cfg.label_smoothing`) — LM-completeness options.
+
+Tying removes the "head" entry from the params pytree entirely, so every
+engine's structural placement/checkpoint logic follows automatically;
+smoothing lives in the ONE token_loss every engine calls.
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.optim import SGD, Adam
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                          max_seq=32)
+TIED = replace(CFG, tie_embeddings=True)
+
+
+def mesh2(dp, m=1, name="sp"):
+    devs = np.array(jax.devices()[: dp * m]).reshape(dp, m)
+    return Mesh(devs, ("dp", name))
+
+
+def batch(step, b=8, t=32, vocab=64):
+    rng = np.random.default_rng([9, step])
+    tok = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+# ------------------------------------------------------------ weight tying
+
+
+def test_tied_params_have_no_head():
+    params = T.init(TIED, seed=0)
+    assert "head" not in params
+    n_tied = sum(np.prod(l.shape)
+                 for l in jax.tree_util.tree_leaves(params))
+    n_untied = sum(np.prod(l.shape)
+                   for l in jax.tree_util.tree_leaves(T.init(CFG, seed=0)))
+    assert n_untied - n_tied == CFG.vocab * CFG.d_model + CFG.vocab
+
+
+def test_tied_logits_use_embedding():
+    params = T.init(TIED, seed=0)
+    tok, _ = batch(0, b=2)
+    logits = T.forward(params, tok, TIED)
+    x = np.asarray(logits)
+    assert x.shape == (2, 32, 64)
+    # gradient flows into tok_emb from BOTH the input and output sides
+    g = jax.grad(lambda p: T.loss(p, tok, np.roll(tok, -1, 1), TIED))(
+        params)
+    assert np.abs(np.asarray(g["tok_emb"])).sum() > 0
+
+
+def test_tied_trains_and_generates():
+    eng = ContextParallelEngine(TIED, Adam(5e-3), mesh2(2), seed=0)
+    losses = [eng.train_batch(*batch(s % 4)) for s in range(30)]
+    assert losses[-1] < losses[0] - 0.3, losses[::10]
+    from shallowspeed_tpu.models.generate import generate
+
+    out = generate(eng.get_canonical_params(),
+                   np.array([[1, 2, 3]], np.int32), TIED, max_new=8,
+                   seed=0)
+    assert np.asarray(out).shape == (1, 8)
+
+
+@pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+def test_tied_pipeline_matches_plain_dp(sched):
+    cfg = replace(TIED, n_layers=4)
+    ref = ContextParallelEngine(cfg, SGD(0.1), mesh2(1), seed=0)
+    eng = PipelineLMEngine(
+        cfg, SGD(0.1),
+        Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "pp")),
+        n_mubatches=2, seed=0, schedule=sched)
+    for s in range(3):
+        tok, tgt = batch(s)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ref.train_batch(tok, tgt), rel=3e-4), (sched, s)
+
+
+def test_tied_tensor_engine_trains():
+    eng = TensorParallelEngine(TIED, Adam(5e-3), mesh2(2, 2, "tp"), seed=0)
+    losses = [eng.train_batch(*batch(s % 4)) for s in range(20)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses[::5]
+
+
+def test_tied_checkpoint_roundtrip(tmp_path):
+    from shallowspeed_tpu import checkpoint
+
+    eng = ContextParallelEngine(TIED, Adam(1e-2), mesh2(2), seed=0)
+    for s in range(2):
+        eng.train_batch(*batch(s))
+    checkpoint.save(tmp_path, eng, 2)
+    eng2 = ContextParallelEngine(TIED, Adam(1e-2), mesh2(2), seed=1)
+    assert checkpoint.restore(eng2, checkpoint.latest(tmp_path)) == 3
+    tok, tgt = batch(5)
+    np.testing.assert_allclose(eng.train_batch(tok, tgt),
+                               eng2.train_batch(tok, tgt), rtol=1e-6)
+
+
+def test_untied_checkpoint_refuses_tied_engine(tmp_path):
+    from shallowspeed_tpu import checkpoint
+
+    eng = ContextParallelEngine(CFG, SGD(0.1), mesh2(1), seed=0)
+    checkpoint.save(tmp_path, eng, 1)
+    eng2 = ContextParallelEngine(TIED, SGD(0.1), mesh2(1), seed=0)
+    with pytest.raises(ValueError, match="does not match"):
+        checkpoint.restore(eng2, checkpoint.latest(tmp_path))
+
+
+# --------------------------------------------------------- label smoothing
+
+
+def test_smoothing_formula():
+    cfg_ls = replace(CFG, label_smoothing=0.2)
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(2, 4, 64)),
+                         jnp.float32)
+    tgt = np.random.default_rng(1).integers(0, 64, (2, 4)).astype(np.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -np.take_along_axis(np.asarray(logp), tgt[..., None], -1)[..., 0]
+    uni = -np.asarray(logp).mean(-1)
+    want = (0.8 * nll + 0.2 * uni).mean()
+    got = float(T.token_loss(logits, jnp.asarray(tgt), cfg_ls))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # ls=0 is the plain NLL
+    np.testing.assert_allclose(float(T.token_loss(logits, jnp.asarray(tgt),
+                                                  CFG)),
+                               nll.mean(), rtol=1e-6)
+
+
+def test_smoothing_is_train_only():
+    """Eval loss/perplexity must be the plain NLL — comparable across
+    runs regardless of --label-smoothing (like dropout, smoothing is a
+    training-only regularizer)."""
+    cfg_ls = replace(CFG, label_smoothing=0.2)
+    plain = ContextParallelEngine(CFG, SGD(0.1), mesh2(1), seed=0)
+    smooth = ContextParallelEngine(cfg_ls, SGD(0.1), mesh2(1), seed=0)
+    tok, tgt = batch(3)
+    assert smooth.eval_loss(tok, tgt) == pytest.approx(
+        plain.eval_loss(tok, tgt), rel=1e-6)
+    # but the training objective differs
+    assert smooth.train_batch(tok, tgt) != pytest.approx(
+        plain.train_batch(tok, tgt), rel=1e-6)
+
+
+def test_smoothing_trains_and_is_shared_by_pipeline():
+    """The pipeline engines call the same token_loss: with smoothing on,
+    the pipeline trajectory still matches the plain DP engine."""
+    cfg = replace(CFG, n_layers=4, label_smoothing=0.1)
+    ref = ContextParallelEngine(cfg, SGD(0.1), mesh2(1), seed=0)
+    eng = PipelineLMEngine(
+        cfg, SGD(0.1),
+        Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "pp")),
+        n_mubatches=2, seed=0, schedule="1f1b")
+    for s in range(3):
+        tok, tgt = batch(s)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ref.train_batch(tok, tgt), rel=3e-4), s

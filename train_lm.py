@@ -84,6 +84,12 @@ def parse_args(argv=None):
                    help="rotary position embeddings (replaces the learned "
                         "absolute embedding; composes with every engine "
                         "and sequence sharding)")
+    p.add_argument("--tie-embeddings", action="store_true",
+                   help="weight tying: the output head reuses tok_emb^T "
+                        "(no separate head matrix)")
+    p.add_argument("--label-smoothing", type=float, default=0.0,
+                   help="mix the one-hot target with the uniform "
+                        "distribution in the loss")
     p.add_argument("--dropout", type=float, default=0.0,
                    help="dropout rate on embeddings and attention/FFN "
                         "outputs (GPT-2 placement); active in training "
@@ -327,7 +333,9 @@ def train(args) -> float:
                             remat=args.remat, rope=args.rope,
                             norm=args.norm, ffn=args.ffn,
                             n_kv_heads=args.kv_heads,
-                            dropout=args.dropout)
+                            dropout=args.dropout,
+                            tie_embeddings=args.tie_embeddings,
+                            label_smoothing=args.label_smoothing)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
