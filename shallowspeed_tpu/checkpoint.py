@@ -98,10 +98,14 @@ def load_pytree(path, with_meta: bool = False):
 # ------------------------------------------------------------- save/restore
 
 
-def save(ckpt_dir, engine, epoch: int) -> Path:
+def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
     """Atomically write `ckpt_dir/ckpt_{epoch}/`: canonical params + engine
     opt state. Writes into `ckpt_{epoch}.tmp/` and renames into place so a
-    crash mid-save cannot produce a directory `latest()` would select."""
+    crash mid-save cannot produce a directory `latest()` would select.
+
+    `extra`: optional {filename-stem: pytree} written INSIDE the atomic
+    rename (e.g. the driver's EMA weights) — a crash can never produce a
+    checkpoint that `latest()` selects but whose side trees are missing."""
     final = Path(ckpt_dir) / f"ckpt_{epoch}"
     tmp = Path(ckpt_dir) / f"ckpt_{epoch}.tmp"
     if tmp.exists():
@@ -110,6 +114,8 @@ def save(ckpt_dir, engine, epoch: int) -> Path:
     save_pytree(tmp / "params.npz", engine.get_canonical_params())
     save_pytree(tmp / "opt.npz", engine.opt_state,
                 meta={"epoch": int(epoch), "engine": type(engine).__name__})
+    for name, tree in (extra or {}).items():
+        save_pytree(tmp / f"{name}.npz", tree)
     if final.exists():
         shutil.rmtree(final)
     tmp.rename(final)

@@ -17,6 +17,7 @@ state, traced into the compiled step so the schedule runs on-device. A
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Any, Callable, Union
 
 import jax
@@ -379,3 +380,28 @@ class Adafactor(_Optimizer):
 
 OPTIMIZERS = {"sgd": SGD, "momentum": MomentumSGD, "adam": Adam,
               "adamw": AdamW, "adafactor": Adafactor}
+
+
+# ------------------------------------------------------------------- EMA
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def ema_update(ema: Any, params: Any, decay) -> Any:
+    """One exponential-moving-average step: ema <- d*ema + (1-d)*params.
+
+    Pure elementwise pytree transform: works on ANY engine's live params
+    (replicated, ZeRO/FSDP-sharded, pipeline-stacked) because the output
+    inherits each leaf's sharding; the old ema buffer is donated, so the
+    running average costs one params-sized buffer total. Engines stay
+    untouched — the driver owns the averaging (and evaluates/samples by
+    temporarily swapping the averaged tree in)."""
+    d = jnp.float32(decay)
+    return tree_map(
+        lambda e, p: (d * e + (1.0 - d) * p.astype(jnp.float32))
+        .astype(e.dtype), ema, params)
+
+
+def ema_init(params: Any) -> Any:
+    """Start the average AT the current params (standard warm init —
+    an all-zeros start would bias early evals toward zero)."""
+    return tree_map(lambda p: p + 0, params)  # copy, keeps sharding

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import time
+from pathlib import Path
 
 import numpy as np
 
@@ -142,6 +143,11 @@ def parse_args(argv=None):
                         "checkpoint (implies --resume) and just --generate")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="keep an exponential moving average of the "
+                        "weights (e.g. 0.999); validation and sampling "
+                        "use the averaged weights, checkpoints carry "
+                        "them (0 = off)")
     p.add_argument("--accum", type=int, default=1,
                    help="gradient accumulation: split each batch into N "
                         "sequential microbatches per device (activation "
@@ -283,6 +289,10 @@ def train(args) -> float:
                          "subsumes --zero1/--zero2; MoE uses --ep)")
     if args.zero1 and args.zero2:
         raise SystemExit("--zero2 subsumes --zero1; pick one")
+    if not 0.0 <= args.ema_decay < 1.0:
+        raise SystemExit(f"--ema-decay must be in [0, 1), got "
+                         f"{args.ema_decay} (1.0 would freeze the average "
+                         f"at the initial weights)")
     if args.accum > 1 and (args.tp > 1 or args.ep > 1 or args.experts
                            or args.fsdp or args.pp > 1):
         raise SystemExit("--accum composes with --dp/--sp (the context "
@@ -396,11 +406,13 @@ def train(args) -> float:
                                        zero2=args.zero2, accum=args.accum)
 
     start_step = 0
+    restored_ckpt = None
     if args.resume or args.sample_only:  # save-dir presence checked early
         ck = checkpoint.latest(args.save_dir)
         if ck is None:
             raise SystemExit(f"--resume: no checkpoint under {args.save_dir!r}")
         start_step = checkpoint.restore(engine, ck)
+        restored_ckpt = ck
         rprint(f"resumed from {ck} at step {start_step}")
 
     if not args.sample_only and start_step >= args.steps:
@@ -413,21 +425,58 @@ def train(args) -> float:
                             n_layers=args.n_layers)
     n_evals = 0
 
+    # ---- EMA of the weights: driver-owned, engine-agnostic (a pure
+    # elementwise update on the engine's live params tree, whatever its
+    # sharding); eval/sampling swap the averaged tree in temporarily
+    from shallowspeed_tpu.optim import ema_init, ema_update
+
+    ema = None
+    if args.ema_decay > 0.0:
+        ema_path = (Path(restored_ckpt) / "ema.npz"
+                    if restored_ckpt is not None else None)
+        if ema_path is not None and ema_path.exists():
+            host = checkpoint.load_pytree(ema_path)
+            ema = jax.tree_util.tree_map(
+                lambda h, p: jax.device_put(np.asarray(h), p.sharding),
+                host, engine.params)
+        else:
+            ema = ema_init(engine.params)
+
+    import contextlib as _ctl
+
+    @_ctl.contextmanager
+    def ema_weights():
+        """Temporarily swap the averaged weights into the engine."""
+        if ema is None:
+            yield
+            return
+        live = engine.params
+        engine.params = ema
+        try:
+            yield
+        finally:
+            engine.params = live
+
     def val_loss() -> float:
         """Held-out loss: --text tail, or a seed stream disjoint from
         training (steps are seeded [seed, step]; val uses [seed+1, ...]).
         Each call draws a FRESH batch of held-out windows (seeded by the
         eval counter) so the metric tracks the distribution, not a fixed
-        handful of examples."""
+        handful of examples. With --ema-decay, evaluates the averaged
+        weights (what you would ship), not the raw iterate."""
         nonlocal n_evals
         n_evals += 1
         val_args = args if val_data is not None else argparse.Namespace(
             **{**vars(args), "seed": args.seed + 1})
         tok, tgt = make_batch(val_args, vocab, 10**9 + n_evals, val_data)
-        return float(engine.eval_loss(local_rows(tok), local_rows(tgt)))
+        with ema_weights():
+            return float(engine.eval_loss(local_rows(tok),
+                                          local_rows(tgt)))
 
     if args.sample_only:
-        sample_and_print(args, engine, cfg, vocab, text_data, tokenizer)
+        with ema_weights():
+            sample_and_print(args, engine, cfg, vocab, text_data,
+                             tokenizer)
         return float("nan")
 
     t0 = time.time()
@@ -459,6 +508,8 @@ def train(args) -> float:
             for step, (tok, tgt) in zip(range(start_step, args.steps),
                                         placed):
                 loss_dev = engine.train_batch_async(tok, tgt)
+                if ema is not None:
+                    ema = ema_update(ema, engine.params, args.ema_decay)
                 if sync_every(step, args.log_every, args.steps):
                     loss = float(loss_dev)
                     if not np.isfinite(loss):
@@ -500,7 +551,10 @@ def train(args) -> float:
                                                  3))
                 if args.save_dir and ((step + 1) % args.save_every == 0
                                       or step == args.steps - 1):
-                    checkpoint.save(args.save_dir, engine, step)
+                    checkpoint.save(
+                        args.save_dir, engine, step,
+                        extra=({"ema": jax.device_get(ema)}
+                               if ema is not None else None))
     finally:
         # abandoning mid-stream must not leave placed batches pinned on
         # device by a blocked producer thread
@@ -508,7 +562,9 @@ def train(args) -> float:
             placed.close()
 
     if args.generate > 0:
-        sample_and_print(args, engine, cfg, vocab, text_data, tokenizer)
+        with ema_weights():
+            sample_and_print(args, engine, cfg, vocab, text_data,
+                             tokenizer)
     return loss
 
 
