@@ -1,0 +1,215 @@
+"""Schedule verification — the `happens_before` upgrade the reference's
+own tests ask for.
+
+The reference's schedule tests check instruction *presence and coarse
+ordering* and say so honestly: "these tests are weak [...] a
+happens_before predicate would be the upgrade"
+(`/root/reference/tests/test_schedules.py:4-10`). This module IS that
+upgrade: it executes all stages' instruction streams against channel
+semantics (activations flow right, cotangents flow left, FIFO per edge)
+and proves, for any (num_stages, num_micro_batches):
+
+- **deadlock-freedom**: every Recv is eventually satisfiable — the
+  schedule can run to completion under blocking channels;
+- **data correctness**: each Forward consumes the activation of ITS
+  microbatch (channel tags must match — a schedule that reorders sends
+  is caught, not just one that forgets them); each Backward consumes the
+  matching cotangent and a stashed forward that exists and is used
+  exactly once;
+- **reduction placement**: exactly one BackwardGradAllReduce per stage
+  per batch, as that stage's final backward, after ZeroGrad and before
+  OptimizerStep (the reference's interleaved-DDP contract,
+  `pipe.py:302-327`);
+- **memory bounds**: the simulator measures each stage's PEAK activation
+  stash, so 1F1B's min(num_stages - stage_id, n_mu) claim is checked,
+  not asserted;
+- **makespan**: unit-cost compute rounds give each schedule's bubble — a
+  quantitative schedule-research metric (Naive >> GPipe ≈ 1F1B).
+
+Pure Python over pure-data schedules: no devices, no arrays — the same
+zero-process testability the schedule layer was designed for.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from shallowspeed_tpu.parallel.instructions import (
+    BackwardGradAcc,
+    BackwardGradAllReduce,
+    Forward,
+    LoadMuBatchInput,
+    LoadMuBatchTarget,
+    OptimizerStep,
+    RecvActivations,
+    RecvOutputGrad,
+    SendActivations,
+    SendInputGrad,
+    ZeroGrad,
+)
+
+_COMPUTE = (Forward, BackwardGradAcc, BackwardGradAllReduce)
+
+
+class ScheduleError(AssertionError):
+    """A schedule violated channel semantics or a pipeline invariant."""
+
+
+@dataclass
+class SimReport:
+    """What the simulator proved/measured for one schedule instance."""
+
+    makespan: int                      # unit-cost compute rounds to drain
+    peak_stash: list                   # per-stage peak in-flight forwards
+    fwd_rounds: dict = field(default_factory=dict)   # (stage, mu) -> round
+    bwd_rounds: dict = field(default_factory=dict)
+
+
+def _flatten(schedule) -> list:
+    return [cmd for step in schedule.steps() for cmd in step]
+
+
+def simulate(schedule_cls, num_micro_batches: int, num_stages: int,
+             training: bool = True) -> SimReport:
+    """Run every stage's instruction stream against FIFO channel
+    semantics; raise ScheduleError on any violation (see module
+    docstring for the list). `training=False` relaxes the
+    backward/reduction invariants (inference schedules)."""
+    n_mu = num_micro_batches
+    progs = [_flatten(schedule_cls(n_mu, num_stages, s))
+             for s in range(num_stages)]
+    pc = [0] * num_stages
+    # channels keyed by receiving stage; values are microbatch tags
+    act_ch = [[] for _ in range(num_stages)]    # from stage s-1
+    grad_ch = [[] for _ in range(num_stages)]   # from stage s+1
+    bufs = [{} for _ in range(num_stages)]      # buffer_id -> mu tag
+    stash = [set() for _ in range(num_stages)]  # forwards awaiting bwd
+    peak = [0] * num_stages
+    fwd_done = [set() for _ in range(num_stages)]
+    bwd_done = [set() for _ in range(num_stages)]
+    allreduce_seen = [False] * num_stages
+    zerograd_seen = [False] * num_stages
+    opt_seen = [False] * num_stages
+    report = SimReport(0, peak)
+
+    def err(s, msg):
+        raise ScheduleError(
+            f"stage {s}/{num_stages}, n_mu={n_mu}, "
+            f"pc={pc[s]} ({progs[s][pc[s]] if pc[s] < len(progs[s]) else 'end'}): {msg}")
+
+    def runnable(s):
+        if pc[s] >= len(progs[s]):
+            return False
+        cmd = progs[s][pc[s]]
+        if isinstance(cmd, RecvActivations):
+            return bool(act_ch[s])
+        if isinstance(cmd, RecvOutputGrad):
+            return bool(grad_ch[s])
+        return True
+
+    def execute(s):
+        cmd = progs[s][pc[s]]
+        if isinstance(cmd, ZeroGrad):
+            if fwd_done[s] or bwd_done[s]:
+                err(s, "ZeroGrad after compute began")
+            zerograd_seen[s] = True
+        elif isinstance(cmd, LoadMuBatchInput):
+            if s != 0:
+                err(s, "LoadMuBatchInput on a non-first stage")
+            bufs[s][cmd.buffer_id] = cmd.mubatch_id
+        elif isinstance(cmd, LoadMuBatchTarget):
+            if s != num_stages - 1:
+                err(s, "LoadMuBatchTarget on a non-last stage")
+            bufs[s][cmd.buffer_id] = cmd.mubatch_id
+        elif isinstance(cmd, RecvActivations):
+            bufs[s][cmd.buffer_id] = act_ch[s].pop(0)
+        elif isinstance(cmd, RecvOutputGrad):
+            bufs[s][cmd.buffer_id] = grad_ch[s].pop(0)
+        elif isinstance(cmd, Forward):
+            got = bufs[s].get(cmd.buffer_id)
+            if got != cmd.mubatch_id:
+                err(s, f"Forward(mu={cmd.mubatch_id}) consumed the "
+                       f"activation of mu={got}")
+            if cmd.mubatch_id in fwd_done[s]:
+                err(s, f"second Forward of mu={cmd.mubatch_id}")
+            fwd_done[s].add(cmd.mubatch_id)
+            if training:
+                stash[s].add(cmd.mubatch_id)
+                peak[s] = max(peak[s], len(stash[s]))
+            report.fwd_rounds[(s, cmd.mubatch_id)] = report.makespan
+        elif isinstance(cmd, SendActivations):
+            if s == num_stages - 1:
+                err(s, "SendActivations off the pipeline's last stage")
+            act_ch[s + 1].append(bufs[s].get(cmd.buffer_id))
+        elif isinstance(cmd, (BackwardGradAcc, BackwardGradAllReduce)):
+            got = bufs[s].get(cmd.buffer_id)
+            if got != cmd.mubatch_id:
+                err(s, f"Backward(mu={cmd.mubatch_id}) consumed the "
+                       f"cotangent of mu={got}")
+            if cmd.mubatch_id not in stash[s]:
+                err(s, f"Backward(mu={cmd.mubatch_id}) without a stashed "
+                       f"forward (missing, or consumed twice)")
+            stash[s].remove(cmd.mubatch_id)
+            bwd_done[s].add(cmd.mubatch_id)
+            report.bwd_rounds[(s, cmd.mubatch_id)] = report.makespan
+            if isinstance(cmd, BackwardGradAllReduce):
+                if allreduce_seen[s]:
+                    err(s, "second BackwardGradAllReduce in one batch")
+                allreduce_seen[s] = True
+            elif allreduce_seen[s]:
+                err(s, "BackwardGradAcc AFTER the all-reduce backward "
+                       "(its gradient would miss the DP reduction)")
+        elif isinstance(cmd, SendInputGrad):
+            if s == 0:
+                err(s, "SendInputGrad off the pipeline's first stage")
+            grad_ch[s - 1].append(bufs[s].get(cmd.buffer_id))
+        elif isinstance(cmd, OptimizerStep):
+            if len(bwd_done[s]) != n_mu:
+                err(s, f"OptimizerStep after only {len(bwd_done[s])}/"
+                       f"{n_mu} backwards")
+            if not allreduce_seen[s]:
+                err(s, "OptimizerStep without a DP all-reduce backward")
+            opt_seen[s] = True
+        else:
+            err(s, f"unknown instruction {cmd}")
+        pc[s] += 1
+
+    # round-based: every stage executes zero-cost instructions freely and
+    # at most ONE compute instruction per round (unit-cost model)
+    while any(pc[s] < len(progs[s]) for s in range(num_stages)):
+        progressed = False
+        for s in range(num_stages):
+            computed = False
+            while runnable(s) and not computed:
+                computed = isinstance(progs[s][pc[s]], _COMPUTE)
+                execute(s)
+                progressed = True
+        if not progressed:
+            stuck = [(s, str(progs[s][pc[s]]))
+                     for s in range(num_stages) if pc[s] < len(progs[s])]
+            raise ScheduleError(
+                f"deadlock with n_mu={n_mu}, stages={num_stages}: every "
+                f"remaining stage is blocked on a Recv: {stuck}")
+        report.makespan += 1
+
+    for s in range(num_stages):
+        if act_ch[s] or grad_ch[s]:
+            err(s, f"undelivered messages at drain: act={act_ch[s]} "
+                   f"grad={grad_ch[s]}")
+        if fwd_done[s] != set(range(n_mu)):
+            err(s, f"forwards run: {sorted(fwd_done[s])} != all {n_mu}")
+        if training:
+            if bwd_done[s] != set(range(n_mu)):
+                err(s, f"backwards run: {sorted(bwd_done[s])}")
+            if not (zerograd_seen[s] and opt_seen[s]):
+                err(s, "missing ZeroGrad/OptimizerStep bracket")
+    # cross-stage happens-before: stage s+1's forward of mu cannot precede
+    # stage s's (tags already prove data flow; this proves the timing)
+    for (s, mu), r in report.fwd_rounds.items():
+        if s + 1 < num_stages:
+            nxt = report.fwd_rounds[(s + 1, mu)]
+            if nxt < r:
+                raise ScheduleError(
+                    f"FWD({s + 1}, {mu}) at round {nxt} precedes "
+                    f"FWD({s}, {mu}) at round {r}")
+    return report

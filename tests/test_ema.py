@@ -36,6 +36,28 @@ def test_ema_preserves_sharding():
     np.testing.assert_allclose(np.asarray(ema["x"]), 0.5)
 
 
+def test_ema_survives_topology_change(tmp_path):
+    """ema.npz is stored canonically (like params.npz): a pipeline run's
+    average restores into a plain-dp sample-only run."""
+    import train_lm
+
+    train_lm.train(train_lm.parse_args(
+        ["--platform", "cpu", "--host-devices", "2", "--dp", "1",
+         "--pp", "2", "--ema-decay", "0.9", "--seq-len", "32",
+         "--d-model", "32", "--n-layers", "2", "--batch-size", "4",
+         "--steps", "4", "--save-every", "4", "--log-every", "2",
+         "--prefetch", "0", "--save-dir", str(tmp_path / "ck")]))
+    assert (tmp_path / "ck" / "ckpt_3" / "ema.npz").exists()
+    # sample-only WITHOUT --pp and WITHOUT --ema-decay: auto-uses the
+    # saved average through the canonical import path
+    out = train_lm.train(train_lm.parse_args(
+        ["--platform", "cpu", "--host-devices", "2", "--seq-len", "32",
+         "--d-model", "32", "--n-layers", "2", "--sample-only",
+         "--generate", "4", "--prefetch", "0",
+         "--save-dir", str(tmp_path / "ck")]))
+    assert np.isnan(out)
+
+
 def test_driver_ema_resume_continues_average(tmp_path):
     """Save/resume must restore the running average, not restart it."""
     import train_lm

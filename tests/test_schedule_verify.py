@@ -1,0 +1,107 @@
+"""Executable happens-before verification of every schedule
+(`parallel/verify.py`) — the upgrade the reference's own test header
+wishes for (`/root/reference/tests/test_schedules.py:4-10`).
+
+The simulator executes all stages against FIFO channel semantics, so
+these tests PROVE deadlock-freedom, per-microbatch data correctness,
+reduction placement, and the 1F1B memory bound for every (stages, n_mu)
+in the grid — with zero devices, inherited from schedules-as-data.
+"""
+
+import pytest
+
+from shallowspeed_tpu.parallel.schedules import (
+    GPipeSchedule,
+    InferenceSchedule,
+    NaiveParallelSchedule,
+    PipeDreamSchedule,
+)
+from shallowspeed_tpu.parallel.verify import ScheduleError, simulate
+
+GRID = [(stages, n_mu) for stages in (1, 2, 3, 4, 5) for n_mu in (1, 2, 4, 6)]
+
+
+@pytest.mark.parametrize("stages,n_mu", GRID)
+@pytest.mark.parametrize("sched", [NaiveParallelSchedule, GPipeSchedule,
+                                   PipeDreamSchedule])
+def test_training_schedules_verify(sched, stages, n_mu):
+    simulate(sched, n_mu, stages)
+
+
+@pytest.mark.parametrize("stages,n_mu", GRID)
+def test_inference_schedule_verifies(stages, n_mu):
+    simulate(InferenceSchedule, n_mu, stages, training=False)
+
+
+@pytest.mark.parametrize("stages,n_mu", [(2, 4), (4, 4), (4, 8), (5, 3)])
+def test_1f1b_stash_bound_measured(stages, n_mu):
+    """The PipeDream memory claim, MEASURED: peak in-flight forwards on
+    stage s is exactly min(stages - s, n_mu) — bounded by depth, while
+    GPipe's peak is n_mu on every stage."""
+    r = simulate(PipeDreamSchedule, n_mu, stages)
+    for s in range(stages):
+        assert r.peak_stash[s] == min(stages - s, n_mu), (s, r.peak_stash)
+        sched = PipeDreamSchedule(n_mu, stages, s)
+        assert r.peak_stash[s] == sched.max_stashed_mubatches()
+    g = simulate(GPipeSchedule, n_mu, stages)
+    assert g.peak_stash == [n_mu] * stages
+
+
+@pytest.mark.parametrize("stages,n_mu", [(2, 4), (4, 4), (4, 8)])
+def test_makespan_ranking(stages, n_mu):
+    """Quantitative bubble comparison under the unit-cost model: Naive
+    (one stage active at a time) is strictly worse than the interleaved
+    schedules; 1F1B never loses to GPipe by more than the drain tail."""
+    naive = simulate(NaiveParallelSchedule, n_mu, stages).makespan
+    gpipe = simulate(GPipeSchedule, n_mu, stages).makespan
+    pd = simulate(PipeDreamSchedule, n_mu, stages).makespan
+    assert gpipe < naive, (gpipe, naive)
+    assert pd < naive, (pd, naive)
+    # 1F1B trades a slightly longer unit-cost makespan (late warmups)
+    # for its bounded stash; it stays within the drain tail of GPipe
+    assert pd <= gpipe + n_mu, (pd, gpipe)
+
+
+def test_broken_schedule_is_caught():
+    """Dropping one send must be detected as a deadlock, not pass."""
+
+    class DroppedSend(GPipeSchedule):
+        def steps_FWD_mubatch(self, mubatch_id):
+            cmds = super().steps_FWD_mubatch(mubatch_id)
+            if mubatch_id == 1 and self.stage_id == 0:
+                cmds = [c for c in cmds
+                        if type(c).__name__ != "SendActivations"]
+            return cmds
+
+    # caught even earlier than deadlock: the NEXT microbatch's forward
+    # consumes the wrong activation (tag mismatch)
+    with pytest.raises(ScheduleError,
+                       match="consumed the activation|deadlock"):
+        simulate(DroppedSend, 4, 3)
+
+
+def test_reordered_sends_are_caught():
+    """Swapping two microbatches' forwards breaks tag matching."""
+
+    class Swapped(GPipeSchedule):
+        def steps(self):
+            steps = list(super().steps())
+            if self.stage_id == 0:  # producer only: consumers still
+                # expect microbatch order 0, 1, ...
+                steps[1], steps[2] = steps[2], steps[1]
+            yield from steps
+
+    with pytest.raises(ScheduleError, match="consumed the activation"):
+        simulate(Swapped, 4, 2)
+
+
+def test_premature_optimizer_step_is_caught():
+    class EarlyOpt(GPipeSchedule):
+        def steps(self):
+            from shallowspeed_tpu.parallel.instructions import OptimizerStep
+
+            steps = list(super().steps())
+            yield from [steps[0], [OptimizerStep()], *steps[1:]]
+
+    with pytest.raises(ScheduleError, match="OptimizerStep after only"):
+        simulate(EarlyOpt, 2, 2)

@@ -17,6 +17,7 @@ Example (virtual 8-device mesh, sequence sharded 4-way):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import time
 from pathlib import Path
 
@@ -431,20 +432,48 @@ def train(args) -> float:
     from shallowspeed_tpu.optim import ema_init, ema_update
 
     ema = None
-    if args.ema_decay > 0.0:
-        ema_path = (Path(restored_ckpt) / "ema.npz"
-                    if restored_ckpt is not None else None)
-        if ema_path is not None and ema_path.exists():
+    ema_path = (Path(restored_ckpt) / "ema.npz"
+                if restored_ckpt is not None else None)
+    have_saved_ema = ema_path is not None and ema_path.exists()
+    if args.ema_decay == 0.0 and have_saved_ema:
+        if args.sample_only:
+            # the checkpoint carries an average — sampling the raw
+            # iterate instead would silently change output quality
+            rprint("checkpoint has EMA weights; sampling the average "
+                   "(pass --ema-decay 0 explicitly? it is the default — "
+                   "delete ema.npz to sample the raw iterate)")
+            args.ema_decay = -1.0  # sentinel: load + use, never update
+        else:
+            rprint("warning: checkpoint has ema.npz but --ema-decay is "
+                   "unset; the running average will NOT be continued")
+    if args.ema_decay != 0.0:
+        if have_saved_ema:
+            # ema.npz is stored in the CANONICAL layout (like params.npz)
+            # so it survives topology changes; install it through the
+            # engine's own canonical-import path, with the same structure
+            # guard restore() applies to params
             host = checkpoint.load_pytree(ema_path)
-            ema = jax.tree_util.tree_map(
-                lambda h, p: jax.device_put(np.asarray(h), p.sharding),
-                host, engine.params)
+            mismatch = checkpoint._structure_mismatch(
+                host, engine.get_canonical_params())
+            if mismatch is None:
+                live = engine.params
+                engine.set_canonical_params(host)
+                ema = engine.params
+                engine.params = live
+            else:
+                rprint(f"warning: ema.npz does not match this model "
+                       f"({mismatch}); restarting the average from the "
+                       f"restored weights")
+                ema = ema_init(engine.params)
         else:
             ema = ema_init(engine.params)
 
-    import contextlib as _ctl
+    def ema_canonical():
+        """The average in the engine-agnostic checkpoint layout."""
+        with ema_weights():
+            return engine.get_canonical_params()
 
-    @_ctl.contextmanager
+    @contextlib.contextmanager
     def ema_weights():
         """Temporarily swap the averaged weights into the engine."""
         if ema is None:
@@ -499,8 +528,6 @@ def train(args) -> float:
     placed = prefetch_to_device(
         batches(), lambda b: (engine.place(b[0]), engine.place(b[1])),
         depth=args.prefetch)
-    import contextlib
-
     profile_ctx = (jax.profiler.trace(args.profile_dir)
                    if args.profile_dir else contextlib.nullcontext())
     try:
@@ -521,7 +548,9 @@ def train(args) -> float:
                             # resolving to the last GOOD checkpoint for
                             # --resume; this snapshot is forensic only
                             path = checkpoint.save(
-                                f"{args.save_dir}/diverged", engine, step)
+                                f"{args.save_dir}/diverged", engine, step,
+                                extra=({"ema": ema_canonical()}
+                                       if ema is not None else None))
                             rprint(f"diverged-state snapshot: {path}")
                         raise SystemExit(
                             f"loss became non-finite ({loss}) at step "
@@ -553,7 +582,7 @@ def train(args) -> float:
                                       or step == args.steps - 1):
                     checkpoint.save(
                         args.save_dir, engine, step,
-                        extra=({"ema": jax.device_get(ema)}
+                        extra=({"ema": ema_canonical()}
                                if ema is not None else None))
     finally:
         # abandoning mid-stream must not leave placed batches pinned on
