@@ -16,8 +16,9 @@ models need real decoding. Designed TPU-first:
   `ops/attention.py` numerics, so cached decoding reproduces the batched
   forward's logits exactly (tested to 1e-4).
 
-Sampling: temperature (0 = greedy argmax) and optional top-k truncation,
-with `jax.random` counter-based keys — reproducible given a seed.
+Sampling: temperature (0 = greedy argmax), optional top-k truncation and/or
+nucleus (top-p) filtering, with `jax.random` counter-based keys —
+reproducible given a seed.
 """
 
 from __future__ import annotations
@@ -133,20 +134,35 @@ def decode_step(params, token, pos, cache, cfg: T.TransformerConfig):
     return logits.astype(jnp.float32), new_cache
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
-    """logits (B, V) f32 -> token ids (B,). temperature 0 = greedy."""
+def _sample(logits, rng, temperature: float, top_k: int,
+            top_p: float = 0.0):
+    """logits (B, V) f32 -> token ids (B,). temperature 0 = greedy;
+    top_k and top_p (nucleus) filters compose (k first, then p)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]       # (B, 1)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the first token always survives)
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p      # mass BEFORE this token
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
+        logits = jnp.where(keep, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature", "top_k"))
+@partial(jax.jit, static_argnames=("cfg", "max_new", "temperature",
+                                   "top_k", "top_p"))
 def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
-             temperature: float = 1.0, top_k: int = 0, seed=0):
+             temperature: float = 1.0, top_k: int = 0,
+             top_p: float = 0.0, seed=0):
     """Generate `max_new` tokens after `prompt` (B, Tp). Returns
     (B, max_new) int32. One compiled program: parallel prefill + a
     `lax.scan` decode loop over the static step count."""
@@ -157,7 +173,8 @@ def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
     cache = init_kv_cache(cfg, b)
     logits, cache = prefill(params, prompt, cfg, cache)
     rng0 = jax.random.PRNGKey(seed)
-    tok0 = _sample(logits, jax.random.fold_in(rng0, 0), temperature, top_k)
+    tok0 = _sample(logits, jax.random.fold_in(rng0, 0), temperature,
+                   top_k, top_p)
 
     # sample-after-decode: the final sampled token never triggers another
     # (discarded) decode pass — exactly max_new - 1 decode steps run
@@ -165,7 +182,7 @@ def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
         tok_prev, cache = carry
         logits, cache = decode_step(params, tok_prev, tp + i, cache, cfg)
         tok = _sample(logits, jax.random.fold_in(rng0, i + 1),
-                      temperature, top_k)
+                      temperature, top_k, top_p)
         return (tok, cache), tok
 
     (_, _), toks = jax.lax.scan(step, (tok0, cache),

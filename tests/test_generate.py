@@ -116,3 +116,37 @@ def test_prompt_overflow_rejected():
     params = T.init(CFG, seed=8)
     with pytest.raises(AssertionError, match="max_seq"):
         generate(params, toks(0, b=1, t=30), CFG, 8)
+
+
+# ------------------------------------------------------- nucleus sampling
+
+
+def test_top_p_restricts_support():
+    """With a peaked distribution and small p only the top token
+    survives; with p=0 (off) sampling matches the unfiltered path."""
+    from shallowspeed_tpu.models.generate import _sample
+
+    rng = jax.random.PRNGKey(0)
+    logits = jnp.log(jnp.asarray(
+        [[0.6, 0.25, 0.1, 0.05]], jnp.float32))
+    for i in range(8):
+        tok = _sample(logits, jax.random.fold_in(rng, i), 1.0, 0,
+                      top_p=0.5)
+        assert int(tok[0]) == 0, int(tok[0])
+    # p=0.7: mass-before test keeps {0.6, 0.25}; token 2/3 never drawn
+    seen = {int(_sample(logits, jax.random.fold_in(rng, i), 1.0, 0,
+                        top_p=0.7)[0]) for i in range(64)}
+    assert seen <= {0, 1}, seen
+    off = {int(_sample(logits, jax.random.fold_in(rng, i), 1.0, 0,
+                       top_p=0.0)[0]) for i in range(256)}
+    assert off == {0, 1, 2, 3}, off
+
+
+def test_top_p_generate_deterministic():
+    params = jax.device_put(T.init(CFG, seed=0))
+    prompt = np.array([[3, 1, 4]], np.int32)
+    a = generate(params, prompt, CFG, max_new=8, temperature=1.0,
+                 top_p=0.9, seed=5)
+    b = generate(params, prompt, CFG, max_new=8, temperature=1.0,
+                 top_p=0.9, seed=5)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

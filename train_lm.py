@@ -136,6 +136,9 @@ def parse_args(argv=None):
                         "model (KV-cache decode) and print them")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.0,
+                   help="nucleus sampling: keep the smallest probability "
+                        "mass >= p (0 = off; composes with --top-k)")
     p.add_argument("--prompt", type=str, default="",
                    help="UTF-8 prompt for --generate (byte-level; default: "
                         "a 16-token prefix from the data stream)")
@@ -617,7 +620,8 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
         prompt = prompt[:1, :16]  # one row, short prefix
     out = np.asarray(generate(
         engine.get_canonical_params(), prompt, cfg, args.generate,
-        temperature=args.temperature, top_k=args.top_k, seed=args.seed))
+        temperature=args.temperature, top_k=args.top_k,
+        top_p=args.top_p, seed=args.seed))
     if tokenizer is not None:
         rprint(f"prompt: {tokenizer.decode_bytes(prompt[0])!r}")
         rprint(f"sample: {tokenizer.decode_bytes(out[0])!r}")
