@@ -15,7 +15,9 @@ Public API (lazily imported so `import shallowspeed_tpu` stays cheap):
         ExpertParallelEngine, FSDPEngine, Composite3DEngine,
         PipelineLMEngine,
         TransformerConfig, generate,
-        SGD, MomentumSGD, Adam, AdamW, OPTIMIZERS, SCHEDULES,
+        SGD, MomentumSGD, Adam, AdamW, Adafactor, ema_update,
+        OPTIMIZERS, SCHEDULES,
+        ByteBPE, train_bpe, simulate_schedule,
         checkpoint, distributed, metrics,
     )
 """
@@ -44,8 +46,15 @@ _EXPORTS = {
     "MomentumSGD": "shallowspeed_tpu.optim",
     "Adam": "shallowspeed_tpu.optim",
     "AdamW": "shallowspeed_tpu.optim",
+    "Adafactor": "shallowspeed_tpu.optim",
+    "ema_init": "shallowspeed_tpu.optim",
+    "ema_update": "shallowspeed_tpu.optim",
     "OPTIMIZERS": "shallowspeed_tpu.optim",
     "SCHEDULES": "shallowspeed_tpu.optim",
+    # data / tooling
+    "ByteBPE": "shallowspeed_tpu.data.tokenizer",
+    "train_bpe": "shallowspeed_tpu.data.tokenizer",
+    "simulate_schedule": "shallowspeed_tpu.parallel.verify",
     # subsystem modules
     "checkpoint": "shallowspeed_tpu.checkpoint",
     "distributed": "shallowspeed_tpu.distributed",

@@ -213,3 +213,8 @@ def simulate(schedule_cls, num_micro_batches: int, num_stages: int,
                     f"FWD({s + 1}, {mu}) at round {nxt} precedes "
                     f"FWD({s}, {mu}) at round {r}")
     return report
+
+
+# public-API alias (`shallowspeed_tpu.simulate_schedule`): the package
+# namespace needs a name that says what is simulated
+simulate_schedule = simulate
