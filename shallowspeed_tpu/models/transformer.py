@@ -83,6 +83,11 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 1e-2
+    # Router z-loss weight (ST-MoE): penalizes router-logit magnitude —
+    # the standard stabilizer for long MoE runs. 0 = off (default, so
+    # existing trajectories are bit-unchanged); 1e-3 is the usual value.
+    # Independent of moe_aux_weight (z-loss-only configs are fine).
+    moe_z_weight: float = 0.0
     # Weight tying (Press & Wolf): the output head reuses tok_emb^T
     # instead of its own (vocab, d) matrix — the params pytree simply has
     # no "head" entry, so every engine's placement/checkpoint logic stays
@@ -296,15 +301,18 @@ def repeat_kv(x, cfg: TransformerConfig):
 def _ffn(p, x, cfg: TransformerConfig, h, key=None):
     """Post-attention half of a block: FFN (dense GELU, SwiGLU, or routed
     MoE) on the norm output `h`, dropout, residual onto `x`.
-    Returns (x, aux)."""
+    Returns (x, (balance aux, router z-loss)) — both unweighted; `loss`
+    owns the weights (so a z-loss-only or balance-only config needs no
+    coupling between the two)."""
     if "moe" in p:
-        y, aux = moe_ffn(p["moe"], h, cfg.moe_top_k, cfg.moe_capacity_factor)
-        return x + _dropout(y, cfg.dropout, key), aux
+        y, aux, z = moe_ffn(p["moe"], h, cfg.moe_top_k,
+                            cfg.moe_capacity_factor)
+        return x + _dropout(y, cfg.dropout, key), (aux, z)
     if "gate" in p:  # SwiGLU: silu(gate) * up, both column-parallel
         u = jax.nn.silu(_dense(p["gate"], h)) * _dense(p["up"], h)
     else:
         u = jax.nn.gelu(_dense(p["up"], h))
-    return x + _dropout(_dense(p["down"], u), cfg.dropout, key), 0.0
+    return x + _dropout(_dense(p["down"], u), cfg.dropout, key), (0.0, 0.0)
 
 
 def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
@@ -372,17 +380,18 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     if dropout_key is not None:
         x = _dropout(x, cfg.dropout,
                      jax.random.fold_in(dropout_key, cfg.n_layers))
-    aux_total = 0.0
+    aux_total, z_total = 0.0, 0.0
     block_fn = _block
     if cfg.remat:
         block_fn = jax.checkpoint(_block, static_argnums=(2, 3, 4))
     for i, blk in enumerate(params["blocks"]):
         k_i = (None if dropout_key is None
                else jax.random.fold_in(dropout_key, i))
-        x, aux = block_fn(blk, x, cfg, attn_fn, False, pos, k_i)
+        x, (aux, z) = block_fn(blk, x, cfg, attn_fn, False, pos, k_i)
         aux_total = aux_total + aux
+        z_total = z_total + z
     x = _norm(params["ln_f"], x, cfg)
-    return head_logits(params, x, cfg), aux_total
+    return head_logits(params, x, cfg), (aux_total, z_total)
 
 
 def forward(params, tokens, cfg: TransformerConfig,
@@ -401,7 +410,9 @@ def loss(params, tokens, targets, cfg: TransformerConfig,
     the caller averages across shards (`lax.pmean`) — exact because all
     blocks have equal size.
     """
-    logits, aux = forward_with_aux(params, tokens, cfg, attn_fn, pos_offset,
-                                   dropout_key)
-    return (token_loss(logits, targets, cfg, train)
-            + cfg.moe_aux_weight * aux)
+    logits, (aux, z) = forward_with_aux(params, tokens, cfg, attn_fn,
+                                        pos_offset, dropout_key)
+    total = token_loss(logits, targets, cfg, train) + cfg.moe_aux_weight * aux
+    if cfg.moe_z_weight > 0.0:
+        total = total + cfg.moe_z_weight * z
+    return total

@@ -15,5 +15,6 @@ from shallowspeed_tpu.ops.attention import (  # noqa: F401
 from shallowspeed_tpu.ops.moe import (  # noqa: F401
     expert_capacity,
     moe_ffn,
+    router_z_loss,
     topk_capacity_routing,
 )

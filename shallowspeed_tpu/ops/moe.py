@@ -16,7 +16,10 @@ GShard/Switch formulation rather than gather/scatter token shuffling:
   layouts to the all-to-all collective that NCCL-style frameworks hand-code
   (see `parallel/expert.py`).
 - **Load balancing** uses the standard Switch-Transformer auxiliary loss
-  (fraction-routed x mean-probability per expert, scaled by E).
+  (fraction-routed x mean-probability per expert, scaled by E), plus the
+  optional router z-loss (ST-MoE, Zoph et al.): mean(logsumexp(logits)^2)
+  penalizes router-logit drift, the standard stabilizer for long MoE runs
+  (large logits make top-k selections brittle, especially under bf16).
 """
 
 from __future__ import annotations
@@ -79,12 +82,22 @@ def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
     return combine, dispatch, aux
 
 
+def router_z_loss(gate_logits: jax.Array) -> jax.Array:
+    """ST-MoE router z-loss: mean over tokens of logsumexp(logits)^2 —
+    pulls the router's log-partition toward 0 without touching the
+    routing distribution's shape."""
+    z = jax.nn.logsumexp(gate_logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(z * z)
+
+
 def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
     """Mixture-of-experts feed-forward layer (drop-in for the dense GELU MLP).
 
     p: {"gate": (d, E), "wi": (E, d, ff), "bi": (E, ff),
         "wo": (E, ff, d), "bo": (E, d)}
-    x: (G, S, d) -> (y (G, S, d), aux scalar)
+    x: (G, S, d) -> (y (G, S, d), balance-aux scalar, router z-loss
+    scalar) — both auxiliaries come back UNWEIGHTED; the model config
+    owns the weights (`moe_aux_weight`, `moe_z_weight`).
 
     The two routing einsums below are where expert parallelism happens: with
     `wi`/`wo` sharded `P('ep', ...)` and `x` sharded over batch, GSPMD turns
@@ -107,4 +120,4 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
     out = (jnp.einsum("egcf,efd->egcd", h, p["wo"])
            + p["bo"][:, None, None, :])
     y = jnp.einsum("gsec,egcd->gsd", combine.astype(x.dtype), out)
-    return y, aux
+    return y, aux, router_z_loss(logits)

@@ -98,7 +98,7 @@ def test_single_expert_equals_dense_ffn():
     p = {"gate": np.zeros((d, 1), np.float32),
          "wi": wi, "bi": np.zeros((1, ff), np.float32),
          "wo": wo, "bo": np.zeros((1, d), np.float32)}
-    y, aux = moe_ffn(p, x, top_k=1, capacity_factor=float(s))
+    y, aux, _z = moe_ffn(p, x, top_k=1, capacity_factor=float(s))
     dense = jax.nn.gelu(x @ wi[0]) @ wo[0]
     np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
                                rtol=1e-4, atol=1e-5)
@@ -174,3 +174,42 @@ def test_moe_with_sequence_sharding():
         tgt = np.roll(tok, -1, axis=1).astype(np.int32)
         assert eng.train_batch(tok, tgt) == pytest.approx(
             ref.train_batch(tok, tgt), rel=3e-4), step
+
+
+# ------------------------------------------------------------ router z-loss
+
+
+def test_router_z_loss_math():
+    from shallowspeed_tpu.ops.moe import router_z_loss
+
+    logits = jnp.asarray([[[1.0, 1.0], [3.0, -1.0]]], jnp.float32)
+    z = np.log(np.exp([1.0, 1.0]).sum()), np.log(np.exp([3.0, -1.0]).sum())
+    want = np.mean(np.square(z))
+    np.testing.assert_allclose(float(router_z_loss(logits)), want,
+                               rtol=1e-6)
+    # shifting logits up increases the penalty, as intended
+    assert float(router_z_loss(logits + 5.0)) > float(router_z_loss(logits))
+
+
+def test_z_weight_scales_linearly_and_decouples_from_balance():
+    from dataclasses import replace
+
+    cfg0 = MOE_CFG
+    params = jax.device_put(T.init(cfg0, seed=0))
+    tok = np.random.default_rng(0).integers(
+        0, cfg0.vocab, (4, 16)).astype(np.int32)
+    tgt = np.roll(tok, -1, axis=1).astype(np.int32)
+
+    def loss_at(**kw):
+        return float(T.loss(params, tok, tgt, replace(cfg0, **kw)))
+
+    l0 = loss_at()
+    l1 = loss_at(moe_z_weight=1e-2)
+    l2 = loss_at(moe_z_weight=2e-2)
+    assert l1 > l0  # the z penalty is nonnegative and generically > 0
+    # the z term is exactly linear in its weight
+    np.testing.assert_allclose(l2 - l0, 2 * (l1 - l0), rtol=1e-4)
+    # and independent of the balance weight: z-loss-only configs work
+    lz_only = loss_at(moe_aux_weight=0.0, moe_z_weight=1e-2)
+    lbal_only = loss_at(moe_aux_weight=0.0)
+    np.testing.assert_allclose(lz_only - lbal_only, l1 - l0, rtol=1e-4)

@@ -52,6 +52,9 @@ def parse_args(argv=None):
     p.add_argument("--experts", type=int, default=0,
                    help="number of MoE experts per block (0 = dense FFN)")
     p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-z-weight", type=float, default=0.0,
+                   help="router z-loss weight (ST-MoE stabilizer; "
+                        "1e-3 typical, 0 = off)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--d-model", type=int, default=128)
@@ -343,6 +346,7 @@ def train(args) -> float:
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             max_seq=args.seq_len, n_experts=args.experts,
                             moe_top_k=args.moe_top_k,
+                            moe_z_weight=args.moe_z_weight,
                             compute_dtype=jnp.bfloat16 if args.bf16 else None,
                             remat=args.remat, rope=args.rope,
                             norm=args.norm, ffn=args.ffn,
