@@ -122,12 +122,14 @@ class PipelineLMEngine:
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  n_mubatches: int = 4, seed: int = 0,
-                 schedule: str = "gpipe"):
+                 schedule: str = "gpipe", attn: str = "xla"):
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp")), (
             f"PipelineLMEngine expects a ('dp','pp'[,'tp']) mesh, got "
             f"{mesh.axis_names}")
         assert schedule in ("gpipe", "1f1b"), schedule
+        assert attn in ("xla", "flash"), attn
         self.schedule = schedule
+        self.attn = attn
         assert cfg.n_experts == 0, (
             "PipelineLMEngine pipelines the dense family; MoE composes "
             "with dp/ep (parallel/expert.py)")
@@ -215,6 +217,21 @@ class PipelineLMEngine:
             def psum_tp(x):
                 return x
 
+        if self.attn == "flash":
+            # the fused Pallas kernel drops into the stage block
+            # unchanged: per-device heads, full (unsharded) microbatch
+            # sequence — and its custom VJP composes with both backward
+            # derivations (autodiff through the GPipe scan, per-tick
+            # jax.vjp in 1F1B)
+            from shallowspeed_tpu.ops.flash_attention import (
+                flash_attention)
+
+            def attn_fn(q, k, v):
+                return flash_attention(q, k, v, causal=True)
+        else:
+            def attn_fn(q, k, v):
+                return attention(q, k, v, causal=True)
+
         def mega_block(blk, x, key=None):
             """One pre-LN block on this device's tp shard: qkv/up columns
             hold `heads_local` whole heads / `4d/tp` neurons, proj/down
@@ -245,8 +262,7 @@ class PipelineLMEngine:
             # group factor is tp-invariant (both head counts divide by tp)
             k = T.repeat_kv(k, cfg)
             v = T.repeat_kv(v, cfg)
-            a = attention(q, k, v, causal=True).reshape(
-                b, t, heads_local * hd)
+            a = attn_fn(q, k, v).reshape(b, t, heads_local * hd)
             x = x + T._dropout(
                 psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"],
                 cfg.dropout, k_attn)

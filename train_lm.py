@@ -122,9 +122,10 @@ def parse_args(argv=None):
                    help="attention substrate: ring (any --sp), ulysses "
                         "(all-to-all; needs n_heads %% sp == 0), "
                         "ulysses-flash (all-to-all + fused Pallas kernel) "
-                        "or the fused Pallas flash kernel (--sp 1 only); "
-                        "with --tp/--fsdp the GSPMD engines use XLA "
-                        "attention (K/V all-gather under --sp)")
+                        "or the fused Pallas flash kernel (--sp 1 only; "
+                        "also drops into each --pp stage, incl. --pp "
+                        "--tp); with --tp/--fsdp alone the GSPMD engines "
+                        "use XLA attention (K/V all-gather under --sp)")
     p.add_argument("--text", type=str, default="",
                    help="train on this UTF-8 text file (byte-level vocab, "
                         "or subword with --tokenizer bpe)")
@@ -285,9 +286,10 @@ def train(args) -> float:
     if args.pp > 1 and (args.sp > 1 or args.ep > 1 or args.experts
                         or args.fsdp or args.zero1 or args.zero2):
         raise SystemExit("--pp composes with --dp and --tp only for now")
-    if args.pp > 1 and args.attn != "ring":
+    if args.pp > 1 and args.attn not in ("ring", "flash"):
         raise SystemExit(f"--attn {args.attn} is not available with --pp "
-                         "(the pipeline engine uses XLA attention)")
+                         "(XLA attention by default, or the fused Pallas "
+                         "kernel via --attn flash)")
     if args.ep > 1 and args.tp > 1:
         raise SystemExit("--ep composes with --dp/--sp (not --tp)")
     if args.fsdp and (args.ep > 1 or args.experts or args.zero1
@@ -307,7 +309,7 @@ def train(args) -> float:
                          "microbatches via --n-mubatches")
     if args.fsdp and (args.sp > 1 or args.tp > 1):
         composite = True  # ZeRO-3 on top of the 3-D mesh
-    if (args.fsdp or args.tp > 1) and args.attn != "ring":
+    if (args.fsdp or args.tp > 1) and args.pp <= 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with "
                          "--tp/--fsdp (the GSPMD engines use XLA attention; "
                          "under --sp the composite engine's context "
@@ -377,7 +379,9 @@ def train(args) -> float:
         engine = PipelineLMEngine(cfg, opt, mesh,
                                   n_mubatches=args.n_mubatches,
                                   seed=args.seed,
-                                  schedule=args.pp_schedule)
+                                  schedule=args.pp_schedule,
+                                  attn="flash" if args.attn == "flash"
+                                  else "xla")
     elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
