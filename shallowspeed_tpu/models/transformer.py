@@ -96,6 +96,12 @@ class TransformerConfig:
     # Label smoothing (Szegedy et al.): mix the one-hot target with the
     # uniform distribution — loss = (1-ls)*NLL + ls*mean(-logp).
     label_smoothing: float = 0.0
+    # Final-logit soft-capping (Gemma 2): logits <- cap*tanh(logits/cap)
+    # bounds the head's output, taming loss spikes late in training.
+    # Applied wherever head logits are produced (training loss AND
+    # decode), so sampling sees the distribution that was trained.
+    # 0 = off; Gemma 2 uses 30.0.
+    logit_softcap: float = 0.0
     # Dropout rate on the embedding sum, each attention output, and each
     # FFN output (GPT-2 placement; attention-probability dropout is
     # deliberately omitted — it would not compose with the fused
@@ -235,10 +241,15 @@ def _dropout(x, rate: float, key):
 
 def head_logits(params, x, cfg: TransformerConfig):
     """Vocabulary projection: the untied head, or tok_emb^T when
-    cfg.tie_embeddings (no bias — the tied head has none)."""
-    if cfg.tie_embeddings:
-        return x @ params["tok_emb"].T
-    return _dense(params["head"], x)
+    cfg.tie_embeddings (no bias — the tied head has none); optionally
+    soft-capped (`cfg.logit_softcap`), in f32 so tanh saturation is not
+    computed in bf16."""
+    logits = (x @ params["tok_emb"].T if cfg.tie_embeddings
+              else _dense(params["head"], x))
+    if cfg.logit_softcap > 0.0:
+        cap = cfg.logit_softcap
+        logits = cap * jnp.tanh(logits.astype(jnp.float32) / cap)
+    return logits
 
 
 def token_loss(logits, targets, cfg: TransformerConfig,

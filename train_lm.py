@@ -76,8 +76,15 @@ def parse_args(argv=None):
     p.add_argument("--lr-schedule", default="constant",
                    choices=["constant", "linear", "cosine"],
                    help="lr schedule; linear/cosine warm up over "
-                        "--warmup-steps then decay to 0 at --steps")
+                        "--warmup-steps then decay to --lr-end at "
+                        "--steps")
     p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--lr-end", type=float, default=0.0,
+                   help="final learning rate the linear/cosine schedules "
+                        "decay to (default 0)")
+    p.add_argument("--logit-softcap", type=float, default=0.0,
+                   help="final-logit soft-capping: cap*tanh(logits/cap) "
+                        "(Gemma-2 style; 30.0 typical, 0 = off)")
     p.add_argument("--bf16", action="store_true",
                    help="mixed precision: bfloat16 compute (MXU-native), "
                         "float32 master weights/optimizer state")
@@ -355,14 +362,16 @@ def train(args) -> float:
                             n_kv_heads=args.kv_heads,
                             dropout=args.dropout,
                             tie_embeddings=args.tie_embeddings,
-                            label_smoothing=args.label_smoothing)
+                            label_smoothing=args.label_smoothing,
+                            logit_softcap=args.logit_softcap)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
         lr = args.lr  # static float keeps SGD stateless (no step counter)
     else:
         lr = SCHEDULES[args.lr_schedule](
-            peak=args.lr, warmup=args.warmup_steps, total=args.steps)
+            peak=args.lr, warmup=args.warmup_steps, total=args.steps,
+            end=args.lr_end)
     opt_kw = {"grad_clip": args.grad_clip or None}
     if args.optimizer in ("adamw", "adafactor"):
         opt_kw["weight_decay"] = args.weight_decay
