@@ -39,7 +39,12 @@ def test_fsdp_excludes_ep_and_zero1():
 def test_attn_guards():
     expect_exit(["--tp", "2", "--attn", "flash"], "not available with")
     expect_exit(["--fsdp", "--attn", "ulysses"], "not available with")
-    expect_exit(["--pp", "2", "--attn", "flash"], "not available with --pp")
+    # --pp takes XLA attention or the fused Pallas kernel; the
+    # sequence-resharding substrates stay rejected
+    expect_exit(["--pp", "2", "--attn", "ulysses"],
+                "not available with --pp")
+    expect_exit(["--pp", "2", "--attn", "ulysses-flash"],
+                "not available with --pp")
 
 
 def test_generate_overflow_fails_at_parse_time():
