@@ -53,6 +53,8 @@ def _cached_attention(q, cache_blk, pos, cfg):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     valid = jnp.arange(k.shape[1]) <= pos                  # (max_seq,)
+    if cfg.attn_window > 0:  # same window the training mask applies
+        valid = valid & (jnp.arange(k.shape[1]) > pos - cfg.attn_window)
     s = jnp.where(valid[None, None, None, :], s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
@@ -101,7 +103,7 @@ def prefill(params, tokens, cfg: T.TransformerConfig, cache):
     params = T.cast_params(params, cfg.compute_dtype)
     tp = tokens.shape[1]
     x = _embed(params, tokens, 0, cfg)
-    attn = partial(T.attention, causal=True)
+    attn = partial(T.attention, causal=True, window=cfg.attn_window)
     pos = jnp.arange(tp)
     for i, blk in enumerate(params["blocks"]):
         x, _aux, (k, v) = T._block(blk, x, cfg, attn, with_kv=True,

@@ -96,6 +96,13 @@ class TransformerConfig:
     # Label smoothing (Szegedy et al.): mix the one-hot target with the
     # uniform distribution — loss = (1-ls)*NLL + ls*mean(-logp).
     label_smoothing: float = 0.0
+    # Sliding-window (local) attention, Mistral-style: position i sees
+    # only [i - attn_window + 1, i]. 0 = full causal attention. Composes
+    # with GQA/rope/remat and the XLA-attention engines (plain dp, the
+    # GSPMD family, the pipeline); the fused/resharded substrates
+    # (flash, ring, ulysses) reject it. The decode cache applies the
+    # same window, so sampling sees the trained distribution.
+    attn_window: int = 0
     # Final-logit soft-capping (Gemma 2): logits <- cap*tanh(logits/cap)
     # bounds the head's output, taming loss spikes late in training.
     # Applied wherever head logits are produced (training loss AND
@@ -119,6 +126,7 @@ class TransformerConfig:
         assert self.ffn in ("gelu", "swiglu"), self.ffn
         assert 0.0 <= self.dropout < 1.0, self.dropout
         assert 0.0 <= self.label_smoothing < 1.0, self.label_smoothing
+        assert self.attn_window >= 0, self.attn_window
         assert self.n_kv_heads >= 0, (
             f"n_kv_heads must be non-negative, got {self.n_kv_heads}")
         assert self.n_heads % self.kv_heads == 0, (
@@ -372,7 +380,7 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     masks.
     """
     if attn_fn is None:
-        attn_fn = partial(attention, causal=True)
+        attn_fn = partial(attention, causal=True, window=cfg.attn_window)
     params = cast_params(params, cfg.compute_dtype)
     b, t = tokens.shape
     # Under jit an out-of-range gather silently clamps to pos_emb's last row;

@@ -39,11 +39,15 @@ from jax import Array, lax
 _NEG = jnp.float32(-1e30)
 
 
-def attention(q: Array, k: Array, v: Array, causal: bool = True) -> Array:
+def attention(q: Array, k: Array, v: Array, causal: bool = True,
+              window: int = 0) -> Array:
     """Multi-head scaled-dot-product attention.
 
     q, k, v: (batch, seq, heads, head_dim). Returns (batch, seq, heads,
-    head_dim). With `causal`, position i attends to positions <= i.
+    head_dim). With `causal`, position i attends to positions <= i;
+    `window > 0` additionally restricts attention to the last `window`
+    positions (sliding-window / local attention, Mistral-style: position
+    i sees [i - window + 1, i]).
 
     Mixed-precision safe: scores accumulate in float32 on the MXU
     (`preferred_element_type`) and the softmax runs in float32 regardless
@@ -53,9 +57,12 @@ def attention(q: Array, k: Array, v: Array, causal: bool = True) -> Array:
     scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
-    if causal:
+    if causal or window > 0:
         tq, tk = q.shape[1], k.shape[1]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        iq, ik = jnp.arange(tq)[:, None], jnp.arange(tk)[None, :]
+        mask = iq >= ik if causal else jnp.ones((tq, tk), bool)
+        if window > 0:
+            mask = mask & (ik > iq - window)
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,

@@ -77,7 +77,14 @@ class ContextParallelEngine:
         self.opt_state = jax.device_put(optimizer.init(self.params), self.rep)
 
         opt = optimizer
-        if attn == "flash":
+        if cfg.attn_window > 0:
+            assert self.sp == 1 and attn == "ring", (
+                "attn_window composes with full XLA attention (sp=1); "
+                "the flash/ring/ulysses substrates do not window")
+            from shallowspeed_tpu.ops.attention import attention as _full
+
+            attn = partial(_full, causal=True, window=cfg.attn_window)
+        elif attn == "flash":
             from shallowspeed_tpu.ops.flash_attention import flash_attention
 
             assert self.sp == 1, "--attn flash requires sp=1 (use ring)"

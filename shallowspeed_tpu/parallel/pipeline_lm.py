@@ -217,7 +217,14 @@ class PipelineLMEngine:
             def psum_tp(x):
                 return x
 
-        if self.attn == "flash":
+        if cfg.attn_window > 0:
+            assert self.attn == "xla", (
+                "attn_window needs XLA attention in the pipeline")
+
+            def attn_fn(q, k, v):
+                return attention(q, k, v, causal=True,
+                                 window=cfg.attn_window)
+        elif self.attn == "flash":
             # the fused Pallas kernel drops into the stage block
             # unchanged: per-device heads, full (unsharded) microbatch
             # sequence — and its custom VJP composes with both backward
@@ -229,6 +236,7 @@ class PipelineLMEngine:
             def attn_fn(q, k, v):
                 return flash_attention(q, k, v, causal=True)
         else:
+
             def attn_fn(q, k, v):
                 return attention(q, k, v, causal=True)
 

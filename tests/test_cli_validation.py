@@ -62,3 +62,10 @@ def test_sample_only_requires_save_dir():
 
 def test_resume_requires_save_dir():
     expect_exit(["--resume"], "require --save-dir")
+
+
+def test_attn_window_guards():
+    expect_exit(["--attn-window", "64", "--sp", "2"],
+                "--attn-window composes with")
+    expect_exit(["--attn-window", "64", "--attn", "flash"],
+                "--attn-window composes with")

@@ -1,0 +1,138 @@
+"""Sliding-window (local) attention (`cfg.attn_window`, `--attn-window`).
+
+Contracts: window >= seq equals full causal attention exactly; a small
+window actually restricts the receptive field; the decode cache applies
+the SAME window so cached sampling reproduces the batched forward; and
+the windowed model trains through the plain, GSPMD, and pipeline
+engines.
+"""
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.ops.attention import attention
+from shallowspeed_tpu.optim import SGD, Adam
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                          max_seq=32)
+
+
+def batch(step, b=4, t=32, vocab=64):
+    rng = np.random.default_rng([13, step])
+    tok = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+def mesh2(dp):
+    return Mesh(np.array(jax.devices()[:dp]).reshape(dp, 1), ("dp", "sp"))
+
+
+# ---------------------------------------------------------------- op level
+
+
+def test_window_geq_seq_is_full_attention():
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 16, 2, 8)), jnp.float32)
+               for _ in range(3))
+    full = attention(q, k, v, causal=True)
+    for w in (16, 100):
+        np.testing.assert_array_equal(
+            np.asarray(attention(q, k, v, causal=True, window=w)),
+            np.asarray(full))
+
+
+def test_window_restricts_receptive_field():
+    """Perturbing a key OUTSIDE the window must not change the output;
+    inside the window it must."""
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 16, 1, 8)), jnp.float32)
+               for _ in range(3))
+    w = 4
+    base = np.asarray(attention(q, k, v, causal=True, window=w))
+    v_out = v.at[0, 2].add(100.0)   # position 2: outside window of q=15
+    np.testing.assert_array_equal(
+        base[0, 15], np.asarray(
+            attention(q, k, v_out, causal=True, window=w))[0, 15])
+    v_in = v.at[0, 14].add(100.0)   # inside [12, 15]
+    assert not np.allclose(
+        base[0, 15], np.asarray(
+            attention(q, k, v_in, causal=True, window=w))[0, 15])
+
+
+# ------------------------------------------------------------- model level
+
+
+def test_windowed_model_differs_and_window_max_matches():
+    params = jax.device_put(T.init(CFG, seed=0))
+    tok, _ = batch(0, b=2)
+    full = np.asarray(T.forward(params, tok, CFG))
+    same = np.asarray(T.forward(
+        params, tok, replace(CFG, attn_window=CFG.max_seq)))
+    np.testing.assert_array_equal(full, same)
+    small = np.asarray(T.forward(params, tok, replace(CFG, attn_window=4)))
+    assert not np.allclose(full, small)
+
+
+def test_decode_matches_windowed_forward():
+    """The KV-cache decode path applies the same window: teacher-forced
+    cached logits equal the batched windowed forward's."""
+    from shallowspeed_tpu.models.generate import (decode_step,
+                                                  init_kv_cache, prefill)
+
+    cfg = replace(CFG, attn_window=4, rope=True, n_kv_heads=2)
+    params = jax.device_put(T.init(cfg, seed=0))
+    tok, _ = batch(0, b=1, t=12)
+    ref = np.asarray(T.forward(params, tok, cfg))        # (1, 12, V)
+    cache = init_kv_cache(cfg, 1)
+    logits, cache = prefill(params, tok[:, :6], cfg, cache)
+    np.testing.assert_allclose(np.asarray(logits)[0], ref[0, 5],
+                               rtol=2e-4, atol=2e-5)
+    for i in range(6, 12):
+        logits, cache = decode_step(params, jnp.asarray(tok[:, i]), i,
+                                    cache, cfg)
+        np.testing.assert_allclose(np.asarray(logits)[0], ref[0, i],
+                                   rtol=2e-4, atol=2e-5)
+
+
+# ------------------------------------------------------------ engine level
+
+
+def test_windowed_training_plain_and_pipeline_agree():
+    cfg = replace(CFG, attn_window=8, n_layers=4)
+    ref = ContextParallelEngine(cfg, SGD(0.1), mesh2(1), seed=0)
+    eng = PipelineLMEngine(
+        cfg, SGD(0.1),
+        Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "pp")),
+        n_mubatches=2, seed=0, schedule="1f1b")
+    for s in range(3):
+        tok, tgt = batch(s, b=8)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ref.train_batch(tok, tgt), rel=3e-4), s
+
+
+def test_windowed_trains():
+    cfg = replace(CFG, attn_window=8)
+    eng = ContextParallelEngine(cfg, Adam(5e-3), mesh2(2), seed=0)
+    losses = [eng.train_batch(*batch(s % 4, b=8)) for s in range(20)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses[::5]
+
+
+def test_window_rejected_on_fused_substrates():
+    cfg = replace(CFG, attn_window=8)
+    with pytest.raises(AssertionError, match="attn_window"):
+        ContextParallelEngine(cfg, SGD(0.1), mesh2(1), seed=0,
+                              attn="flash")
+    with pytest.raises(AssertionError, match="attn_window"):
+        PipelineLMEngine(
+            cfg, SGD(0.1),
+            Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "pp")),
+            seed=0, attn="flash")

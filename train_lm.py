@@ -82,6 +82,10 @@ def parse_args(argv=None):
     p.add_argument("--lr-end", type=float, default=0.0,
                    help="final learning rate the linear/cosine schedules "
                         "decay to (default 0)")
+    p.add_argument("--attn-window", type=int, default=0,
+                   help="sliding-window attention: each position sees "
+                        "only the last N positions (0 = full causal; "
+                        "XLA-attention engines only)")
     p.add_argument("--logit-softcap", type=float, default=0.0,
                    help="final-logit soft-capping: cap*tanh(logits/cap) "
                         "(Gemma-2 style; 30.0 typical, 0 = off)")
@@ -305,6 +309,11 @@ def train(args) -> float:
                          "subsumes --zero1/--zero2; MoE uses --ep)")
     if args.zero1 and args.zero2:
         raise SystemExit("--zero2 subsumes --zero1; pick one")
+    if args.attn_window > 0 and (args.attn != "ring" or args.sp > 1):
+        raise SystemExit("--attn-window composes with full XLA attention "
+                         "(the default --attn ring at --sp 1, including "
+                         "--tp/--fsdp/--pp); the flash/ring/ulysses "
+                         "substrates do not window")
     if not 0.0 <= args.ema_decay < 1.0:
         raise SystemExit(f"--ema-decay must be in [0, 1), got "
                          f"{args.ema_decay} (1.0 would freeze the average "
@@ -363,7 +372,8 @@ def train(args) -> float:
                             dropout=args.dropout,
                             tie_embeddings=args.tie_embeddings,
                             label_smoothing=args.label_smoothing,
-                            logit_softcap=args.logit_softcap)
+                            logit_softcap=args.logit_softcap,
+                            attn_window=args.attn_window)
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
