@@ -98,6 +98,25 @@ def load_pytree(path, with_meta: bool = False):
 # ------------------------------------------------------------- save/restore
 
 
+def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
+                extra: dict) -> Path:
+    """The one encoding of the on-disk layout + atomic rename, shared by
+    the synchronous and async save paths (they must never drift)."""
+    final = Path(ckpt_dir) / f"ckpt_{epoch}"
+    tmp = Path(ckpt_dir) / f"ckpt_{epoch}.tmp"
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    save_pytree(tmp / "params.npz", params)
+    save_pytree(tmp / "opt.npz", opt_state, meta=meta)
+    for name, tree in extra.items():
+        save_pytree(tmp / f"{name}.npz", tree)
+    if final.exists():
+        shutil.rmtree(final)
+    tmp.rename(final)
+    return final
+
+
 def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
     """Atomically write `ckpt_dir/ckpt_{epoch}/`: canonical params + engine
     opt state. Writes into `ckpt_{epoch}.tmp/` and renames into place so a
@@ -106,20 +125,77 @@ def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
     `extra`: optional {filename-stem: pytree} written INSIDE the atomic
     rename (e.g. the driver's EMA weights) — a crash can never produce a
     checkpoint that `latest()` selects but whose side trees are missing."""
-    final = Path(ckpt_dir) / f"ckpt_{epoch}"
-    tmp = Path(ckpt_dir) / f"ckpt_{epoch}.tmp"
-    if tmp.exists():
-        shutil.rmtree(tmp)
-    tmp.mkdir(parents=True)
-    save_pytree(tmp / "params.npz", engine.get_canonical_params())
-    save_pytree(tmp / "opt.npz", engine.opt_state,
-                meta={"epoch": int(epoch), "engine": type(engine).__name__})
-    for name, tree in (extra or {}).items():
-        save_pytree(tmp / f"{name}.npz", tree)
-    if final.exists():
-        shutil.rmtree(final)
-    tmp.rename(final)
-    return final
+    return _write_ckpt(
+        ckpt_dir, epoch, engine.get_canonical_params(), engine.opt_state,
+        {"epoch": int(epoch), "engine": type(engine).__name__},
+        extra or {})
+
+
+class AsyncSaver:
+    """Non-blocking checkpointing: the device->host snapshot happens on
+    the caller's thread (cheap, and it pins the state at the save point),
+    then compression + npz writing + the atomic rename run on ONE
+    background worker — the training loop never blocks on disk. Saves
+    are serialized (a single worker), so checkpoints land in order;
+    `wait()` drains the queue (call it before reading `latest()` or
+    exiting). Errors surface on the next save()/wait() call rather than
+    being swallowed."""
+
+    def __init__(self):
+        import queue
+        import threading
+
+        self._q = queue.Queue()
+        self._err = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            fn = item
+            try:
+                fn()
+            except BaseException as e:  # surfaced on the caller's side
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError("async checkpoint save failed") from err
+
+    def save(self, ckpt_dir, engine, epoch: int,
+             extra: dict | None = None) -> None:
+        """Snapshot now, write later. The snapshot is a host copy, so
+        the engine may keep training (and donating buffers) immediately."""
+        self._raise_pending()
+        params = jax.device_get(engine.get_canonical_params())
+        opt_state = jax.device_get(engine.opt_state)
+        extra_host = {k: jax.device_get(v)
+                      for k, v in (extra or {}).items()}
+        meta = {"epoch": int(epoch), "engine": type(engine).__name__}
+
+        def write():
+            _write_ckpt(ckpt_dir, epoch, params, opt_state, meta,
+                        extra_host)
+
+        self._q.put(write)
+
+    def wait(self) -> None:
+        """Block until every queued save is on disk; re-raise failures."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        self._q.join()
+        self._q.put(None)
+        self._q.join()
+        self._raise_pending()
 
 
 def latest(ckpt_dir) -> Path | None:

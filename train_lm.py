@@ -176,6 +176,10 @@ def parse_args(argv=None):
                    help="input-pipeline depth: batches built + placed on "
                         "device this many steps ahead on a background "
                         "thread (0 = synchronous)")
+    p.add_argument("--async-save", action="store_true",
+                   help="write checkpoints on a background thread: the "
+                        "device->host snapshot is synchronous (pins the "
+                        "state), compression/IO never blocks training")
     p.add_argument("--save-every", type=int, default=100,
                    help="checkpoint every N steps when --save-dir is set")
     p.add_argument("--save-dir", type=str, default="")
@@ -455,6 +459,14 @@ def train(args) -> float:
                             seq_len=args.seq_len, d_model=args.d_model,
                             n_layers=args.n_layers)
     n_evals = 0
+    saver = checkpoint.AsyncSaver() if args.async_save else None
+
+    def save_ckpt(ckpt_dir, step):
+        extra = ({"ema": ema_canonical()} if ema is not None else None)
+        if saver is not None:
+            saver.save(ckpt_dir, engine, step, extra=extra)
+        else:
+            checkpoint.save(ckpt_dir, engine, step, extra=extra)
 
     # ---- EMA of the weights: driver-owned, engine-agnostic (a pure
     # elementwise update on the engine's live params tree, whatever its
@@ -577,10 +589,10 @@ def train(args) -> float:
                             # under diverged/ so checkpoint.latest() keeps
                             # resolving to the last GOOD checkpoint for
                             # --resume; this snapshot is forensic only
-                            path = checkpoint.save(
-                                f"{args.save_dir}/diverged", engine, step,
-                                extra=({"ema": ema_canonical()}
-                                       if ema is not None else None))
+                            save_ckpt(f"{args.save_dir}/diverged", step)
+                            if saver is not None:
+                                saver.wait()
+                            path = f"{args.save_dir}/diverged/ckpt_{step}"
                             rprint(f"diverged-state snapshot: {path}")
                         raise SystemExit(
                             f"loss became non-finite ({loss}) at step "
@@ -610,15 +622,14 @@ def train(args) -> float:
                                                  3))
                 if args.save_dir and ((step + 1) % args.save_every == 0
                                       or step == args.steps - 1):
-                    checkpoint.save(
-                        args.save_dir, engine, step,
-                        extra=({"ema": ema_canonical()}
-                               if ema is not None else None))
+                    save_ckpt(args.save_dir, step)
     finally:
         # abandoning mid-stream must not leave placed batches pinned on
         # device by a blocked producer thread
         if hasattr(placed, "close"):
             placed.close()
+        if saver is not None:
+            saver.close()  # drain queued writes; surface any IO error
 
     if args.generate > 0:
         with ema_weights():
