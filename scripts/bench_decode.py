@@ -1,0 +1,91 @@
+"""Decode benchmark: prefill tok/s and steady-state decode tok/s.
+
+Round 1 had no generation perf number at all (VERDICT item 6). The whole
+generation — parallel prefill + a `lax.scan` decode loop — is ONE
+compiled XLA program (`models/generate.py`), so per-dispatch tunnel
+latency (~50 ms here) is paid once per measurement, not per token.
+
+Method: time `generate(max_new=N1)` and `generate(max_new=N2)` (compiled,
+best of 3 each); steady decode rate = (N2-N1) * B / (t2 - t1) — the
+difference cancels the prefill and the fixed dispatch cost. Prefill tok/s
+= B * Tp / t(max_new=1). GQA rows show the decode-bandwidth win of the
+unrepeated-cache grouped attention (`generate._cached_attention`).
+
+Usage: python scripts/bench_decode.py  — prints one JSON line per config.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def time_generate(params, prompt, cfg, max_new, reps=3):
+    import jax
+
+    from shallowspeed_tpu.models.generate import generate
+
+    out = generate(params, prompt, cfg, max_new, temperature=0.0)
+    jax.device_get(out)  # compile + drain (excluded)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.device_get(generate(params, prompt, cfg, max_new,
+                                temperature=0.0))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_config(batch, prompt_len, max_seq, kv_heads=0, d_model=1024,
+               n_layers=8, n_heads=16):
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        vocab=256, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        max_seq=max_seq, dtype=np.float32,
+        compute_dtype=np.dtype("bfloat16"), rope=True, norm="rmsnorm",
+        ffn="swiglu", n_kv_heads=kv_heads)
+    params = jax.device_put(T.init(cfg, seed=0))
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab, (batch, prompt_len)).astype(np.int32)
+
+    n1 = 32
+    n2 = min(256, max_seq - prompt_len)
+    t_pre = time_generate(params, prompt, cfg, 1)
+    t1 = time_generate(params, prompt, cfg, n1)
+    t2 = time_generate(params, prompt, cfg, n2)
+    decode_tps = (n2 - n1) * batch / max(t2 - t1, 1e-9)
+    return {
+        "metric": "decode_throughput",
+        "config": {"batch": batch, "prompt_len": prompt_len,
+                   "max_seq": max_seq, "d_model": d_model,
+                   "n_layers": n_layers, "n_heads": n_heads,
+                   "kv_heads": kv_heads or n_heads},
+        "prefill_tokens_per_sec": round(batch * prompt_len / t_pre, 0),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+        "decode_ms_per_token": round(1000.0 / (decode_tps / batch), 3),
+    }
+
+
+def main():
+    for kwargs in (
+        {"batch": 1, "prompt_len": 512, "max_seq": 2048},
+        {"batch": 8, "prompt_len": 512, "max_seq": 2048},
+        {"batch": 32, "prompt_len": 128, "max_seq": 1024},
+        # GQA 4x fewer kv heads: the cache sweep shrinks 4x
+        {"batch": 8, "prompt_len": 512, "max_seq": 2048, "kv_heads": 4},
+        {"batch": 1, "prompt_len": 512, "max_seq": 2048, "kv_heads": 4},
+    ):
+        print(json.dumps(run_config(**kwargs)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

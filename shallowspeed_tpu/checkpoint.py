@@ -11,10 +11,18 @@ repo is the PyTorch baseline's `torch.save`,
   written by a dp=4 fused run restores into a dp=2 x pp=4 SPMD run — the
   payoff of the reference's deterministic partitioning design
   (`layers.py:104-113`) carried over to serialized state.
-- **Optimizer state** is engine-shaped (stacked/padded for the SPMD engine),
-  so it round-trips exactly when the engine kind matches and is re-initialized
-  otherwise (with a warning) — resuming SGD is always exact since its state
-  is empty.
+- **Optimizer state** is engine-shaped in `opt.npz` (exact same-kind
+  round trip) and ALSO available canonically (per-layer, unpadded,
+  engine-agnostic like params): for identity-layout engines (the GSPMD
+  family, context, fused DP) `opt.npz` already IS canonical (flagged
+  `opt_is_canonical` in meta — no duplicate file, no second device
+  fetch); layout-transforming engines (the pipeline) additionally write
+  `opt_canon.npz` via `Optimizer.map_state_trees` + their params-layout
+  transform. Cross-engine resume then restores moments exactly (a dp=4
+  Adam checkpoint resumes into dp=2 x pp=4); only pairs with genuinely
+  non-portable state (Adafactor's factored vectors across factoring-
+  incompatible placements, the per-stage MLP instruction-VM) fall back
+  to re-initialization with a warning.
 - On-disk format: one `.npz` per pytree — numbered array leaves plus a JSON
   structure descriptor. No pickle anywhere (a checkpoint from an untrusted
   source cannot execute code at load time), no orbax dependency, loadable
@@ -99,7 +107,7 @@ def load_pytree(path, with_meta: bool = False):
 
 
 def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
-                extra: dict) -> Path:
+                extra: dict, opt_canon=None, canon_meta=None) -> Path:
     """The one encoding of the on-disk layout + atomic rename, shared by
     the synchronous and async save paths (they must never drift)."""
     final = Path(ckpt_dir) / f"ckpt_{epoch}"
@@ -109,12 +117,69 @@ def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
     tmp.mkdir(parents=True)
     save_pytree(tmp / "params.npz", params)
     save_pytree(tmp / "opt.npz", opt_state, meta=meta)
+    if opt_canon is not None:
+        save_pytree(tmp / "opt_canon.npz", opt_canon, meta=canon_meta)
     for name, tree in extra.items():
         save_pytree(tmp / f"{name}.npz", tree)
     if final.exists():
         shutil.rmtree(final)
     tmp.rename(final)
     return final
+
+
+def _canon_opt_export(engine, host_opt_state=None):
+    """Engine-agnostic optimizer state for `opt_canon.npz`, or
+    (None, None) when none is needed or possible.
+
+    Identity-layout engines (params ARE canonical: the GSPMD family,
+    ContextParallelEngine, FusedDPEngine) return None too — their
+    `opt.npz` already IS the canonical record (flagged
+    `opt_is_canonical` in the main meta), so writing it twice would
+    double checkpoint bytes and the device->host fetch for nothing.
+    Layout-transforming engines (`PipelineLMEngine`) re-layout
+    exactly-params-shaped moments with the same transform their params
+    take (`Optimizer.map_state_trees`). `host_opt_state`: an
+    already-fetched host copy to reuse (the async saver has one)."""
+    opt = getattr(engine, "optimizer", None)
+    if opt is None or getattr(engine, "canonical_opt_identity", False):
+        return None, None
+    export = getattr(engine, "canon_export_tree", None)
+    if export is None:
+        return None, None
+    if host_opt_state is None:
+        host_opt_state = jax.device_get(engine.opt_state)
+    try:
+        return (opt.map_state_trees(host_opt_state, export),
+                {"optimizer": type(opt).__name__})
+    except ValueError:
+        return None, None
+
+
+def _opt_meta(engine, epoch: int) -> dict:
+    opt = getattr(engine, "optimizer", None)
+    return {
+        "epoch": int(epoch),
+        "engine": type(engine).__name__,
+        "optimizer": None if opt is None else type(opt).__name__,
+        # True => opt.npz doubles as the canonical record (identity
+        # layout); cross-engine restore may import it directly
+        "opt_is_canonical": bool(
+            getattr(engine, "canonical_opt_identity", False)),
+    }
+
+
+def _canon_opt_import(engine, canon):
+    """Inverse of `_canon_opt_export`: canonical state -> this engine's
+    shape (host-side). None when this engine can't import."""
+    if getattr(engine, "canonical_opt_identity", False):
+        return canon
+    imp = getattr(engine, "canon_import_tree", None)
+    if imp is None:
+        return None
+    try:
+        return engine.optimizer.map_state_trees(canon, imp)
+    except ValueError:
+        return None
 
 
 def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
@@ -125,10 +190,10 @@ def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
     `extra`: optional {filename-stem: pytree} written INSIDE the atomic
     rename (e.g. the driver's EMA weights) — a crash can never produce a
     checkpoint that `latest()` selects but whose side trees are missing."""
+    opt_canon, canon_meta = _canon_opt_export(engine)
     return _write_ckpt(
         ckpt_dir, epoch, engine.get_canonical_params(), engine.opt_state,
-        {"epoch": int(epoch), "engine": type(engine).__name__},
-        extra or {})
+        _opt_meta(engine, epoch), extra or {}, opt_canon, canon_meta)
 
 
 class AsyncSaver:
@@ -145,7 +210,11 @@ class AsyncSaver:
         import queue
         import threading
 
-        self._q = queue.Queue()
+        # maxsize bounds host memory: each queued save pins a full host
+        # snapshot of params+opt state (+EMA); if disk IO is slower than
+        # the --save-every cadence, save() backpressures the training
+        # loop instead of accumulating snapshots without bound.
+        self._q = queue.Queue(maxsize=2)
         self._err = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -176,13 +245,14 @@ class AsyncSaver:
         self._raise_pending()
         params = jax.device_get(engine.get_canonical_params())
         opt_state = jax.device_get(engine.opt_state)
+        opt_canon, canon_meta = _canon_opt_export(engine, opt_state)
         extra_host = {k: jax.device_get(v)
                       for k, v in (extra or {}).items()}
-        meta = {"epoch": int(epoch), "engine": type(engine).__name__}
+        meta = _opt_meta(engine, epoch)
 
         def write():
             _write_ckpt(ckpt_dir, epoch, params, opt_state, meta,
-                        extra_host)
+                        extra_host, opt_canon, canon_meta)
 
         self._q.put(write)
 
@@ -226,6 +296,38 @@ def _structure_mismatch(a, b) -> str | None:
     return None
 
 
+def _restore_opt_canonical(engine, d: Path, opt_state, meta) -> bool:
+    """Try the engine-agnostic optimizer record: `opt_canon.npz` if
+    present, else `opt.npz` itself when its meta says the writing
+    engine's layout was canonical (identity engines skip the duplicate
+    file). Returns True when the state was installed."""
+    path = d / "opt_canon.npz"
+    if path.exists():
+        canon, cmeta = load_pytree(path, with_meta=True)
+        src_kind = cmeta.get("optimizer")
+    elif meta.get("opt_is_canonical"):
+        canon, src_kind = opt_state, meta.get("optimizer")
+    else:
+        return False
+    opt = getattr(engine, "optimizer", None)
+    if opt is None or src_kind != type(opt).__name__:
+        if opt is not None:
+            warnings.warn(
+                f"canonical opt state is {src_kind} but this "
+                f"engine runs {type(opt).__name__}; re-initializing")
+        return False
+    state = _canon_opt_import(engine, canon)
+    if state is None:
+        return False
+    mismatch = _structure_mismatch(state, engine.opt_state)
+    if mismatch is not None:
+        warnings.warn(f"canonical opt state does not match this engine's "
+                      f"optimizer topology ({mismatch}); re-initializing")
+        return False
+    engine.set_opt_state(state)
+    return True
+
+
 def restore(engine, ckpt_path) -> int:
     """Load a checkpoint into `engine` (any kind). Returns the next epoch.
 
@@ -248,9 +350,15 @@ def restore(engine, ckpt_path) -> int:
             and _structure_mismatch(opt_state, engine.opt_state) is None):
         engine.set_opt_state(opt_state)
     elif len(jax.tree_util.tree_leaves(opt_state)) > 0:
-        warnings.warn(
-            f"checkpoint opt state is {meta['engine']}-shaped and does not "
-            f"match this {type(engine).__name__}'s topology; re-initializing")
+        # cross-engine: the canonical (per-layer, unpadded) moment record
+        # makes e.g. a dp=4 Adam checkpoint resume EXACTLY into dp=2 x
+        # pp=4 — the same engine-agnosticism params have always had
+        restored = _restore_opt_canonical(engine, d, opt_state, meta)
+        if not restored:
+            warnings.warn(
+                f"checkpoint opt state is {meta['engine']}-shaped and "
+                f"does not match this {type(engine).__name__}'s topology "
+                f"(no importable canonical record); re-initializing")
     nxt = int(meta["epoch"]) + 1
     if hasattr(engine, "_step_count"):
         # dropout keys derive from the per-engine step counter: resume it

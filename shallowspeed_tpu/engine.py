@@ -168,6 +168,9 @@ class FusedDPEngine:
 
     # -------------------------------------------------- checkpoint interface
 
+    # the pp=1 layout IS canonical, so moments interchange as-is
+    canonical_opt_identity = True
+
     def get_canonical_params(self):
         """pp=1 params ARE the canonical flat layer list; host conversion
         happens once in checkpoint.save_pytree."""

@@ -45,21 +45,26 @@ def _cached_attention(q, cache_blk, pos, cfg):
     """q: (B, 1, H, hd) at position `pos`; attends over cache[:, :pos+1].
 
     The cache tail beyond `pos` is zeros — masked out by position, so its
-    contents never matter. GQA caches hold Hkv heads; repeat at use.
+    contents never matter. GQA caches hold Hkv heads and are read
+    UNREPEATED (grouped einsum): decode is HBM-bandwidth-bound on the
+    cache sweep, so the group factor shrinks the per-step traffic, not
+    just the cache footprint.
     """
-    k = T.repeat_kv(cache_blk["k"], cfg)
-    v = T.repeat_kv(cache_blk["v"], cfg)
-    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    k, v = cache_blk["k"], cache_blk["v"]
+    b, _, h, hd = q.shape
+    kvh = k.shape[2]
+    qg = q.reshape(b, 1, kvh, h // kvh, hd)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                    preferred_element_type=jnp.float32) * scale
     valid = jnp.arange(k.shape[1]) <= pos                  # (max_seq,)
     if cfg.attn_window > 0:  # same window the training mask applies
         valid = valid & (jnp.arange(k.shape[1]) > pos - cfg.attn_window)
-    s = jnp.where(valid[None, None, None, :], s, jnp.float32(-1e30))
+    s = jnp.where(valid[None, None, None, None, :], s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.reshape(b, 1, h, hd).astype(q.dtype)
 
 
 def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):

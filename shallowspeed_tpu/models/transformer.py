@@ -312,9 +312,23 @@ def _qkv(p, h, cfg: TransformerConfig):
 
 
 def repeat_kv(x, cfg: TransformerConfig):
-    """Broadcast K/V heads to the full query-head count (no-op for MHA)."""
+    """Broadcast K/V heads to the full query-head count (no-op for MHA).
+
+    Only needed for attention substrates that predate native GQA; every
+    substrate in `ops/attention.py` and `ops/flash_attention.py` declares
+    `supports_gqa` and consumes the unrepeated heads directly (kernel
+    q-row group folding / grouped einsums), so the hot paths never
+    materialize the repeat — the group-factor saving covers compute and
+    bandwidth, not just cache storage."""
     g = cfg.n_heads // cfg.kv_heads
     return x if g == 1 else jnp.repeat(x, g, axis=2)
+
+
+def _supports_gqa(fn) -> bool:
+    """Unwrap functools.partial layers to read a substrate's GQA tag."""
+    while isinstance(fn, partial):
+        fn = fn.func
+    return bool(getattr(fn, "supports_gqa", False))
 
 
 def _ffn(p, x, cfg: TransformerConfig, h, key=None):
@@ -324,14 +338,15 @@ def _ffn(p, x, cfg: TransformerConfig, h, key=None):
     owns the weights (so a z-loss-only or balance-only config needs no
     coupling between the two)."""
     if "moe" in p:
-        y, aux, z = moe_ffn(p["moe"], h, cfg.moe_top_k,
-                            cfg.moe_capacity_factor)
-        return x + _dropout(y, cfg.dropout, key), (aux, z)
+        y, aux, z, st = moe_ffn(p["moe"], h, cfg.moe_top_k,
+                                cfg.moe_capacity_factor)
+        return x + _dropout(y, cfg.dropout, key), (aux, z, st)
     if "gate" in p:  # SwiGLU: silu(gate) * up, both column-parallel
         u = jax.nn.silu(_dense(p["gate"], h)) * _dense(p["up"], h)
     else:
         u = jax.nn.gelu(_dense(p["up"], h))
-    return x + _dropout(_dense(p["down"], u), cfg.dropout, key), (0.0, 0.0)
+    return (x + _dropout(_dense(p["down"], u), cfg.dropout, key),
+            (0.0, 0.0, None))
 
 
 def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
@@ -359,7 +374,10 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
         q = rope_rotate(q, pos, cfg.rope_theta)
         k = rope_rotate(k, pos, cfg.rope_theta)
     kv_cacheable = (k, v)  # rotated, UNREPEATED — the decode cache layout
-    a = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, t, d)
+    if _supports_gqa(attn_fn):  # native GQA: no repeated K/V materialized
+        a = attn_fn(q, k, v).reshape(b, t, d)
+    else:
+        a = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, t, d)
     x = x + _dropout(_dense(p["proj"], a), cfg.dropout, k_attn)
     h = _norm(p["ln2"], x, cfg)
     x, aux = _ffn(p, x, cfg, h, k_ffn)
@@ -369,8 +387,15 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
 
 
 def forward_with_aux(params, tokens, cfg: TransformerConfig,
-                     attn_fn=None, pos_offset=0, dropout_key=None):
+                     attn_fn=None, pos_offset=0, dropout_key=None,
+                     with_stats: bool = False):
     """tokens: (batch, seq) int32 -> (logits (batch, seq, vocab), moe aux).
+
+    With `with_stats=True` additionally returns layer-averaged MoE
+    routing statistics ({"load": (E,), "drop_fraction": scalar}, or None
+    for dense configs) as a third element — observability for the
+    silent capacity drop (`ops/moe.py`); when unused, XLA dead-code-
+    eliminates the accounting.
 
     `attn_fn(q, k, v)` defaults to full causal attention; a context-parallel
     caller passes `partial(ring_attention, axis_name='sp')` and the global
@@ -400,17 +425,27 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
         x = _dropout(x, cfg.dropout,
                      jax.random.fold_in(dropout_key, cfg.n_layers))
     aux_total, z_total = 0.0, 0.0
+    stats_sum, n_moe = None, 0
     block_fn = _block
     if cfg.remat:
         block_fn = jax.checkpoint(_block, static_argnums=(2, 3, 4))
     for i, blk in enumerate(params["blocks"]):
         k_i = (None if dropout_key is None
                else jax.random.fold_in(dropout_key, i))
-        x, (aux, z) = block_fn(blk, x, cfg, attn_fn, False, pos, k_i)
+        x, (aux, z, st) = block_fn(blk, x, cfg, attn_fn, False, pos, k_i)
         aux_total = aux_total + aux
         z_total = z_total + z
+        if st is not None:
+            stats_sum = (st if stats_sum is None else
+                         jax.tree_util.tree_map(jnp.add, stats_sum, st))
+            n_moe += 1
     x = _norm(params["ln_f"], x, cfg)
-    return head_logits(params, x, cfg), (aux_total, z_total)
+    logits = head_logits(params, x, cfg)
+    if with_stats:
+        stats = (None if stats_sum is None else jax.tree_util.tree_map(
+            lambda v: v / n_moe, stats_sum))
+        return logits, (aux_total, z_total), stats
+    return logits, (aux_total, z_total)
 
 
 def forward(params, tokens, cfg: TransformerConfig,

@@ -1,4 +1,4 @@
-"""Attention ops — full softmax attention and ring attention.
+"""Attention ops — full softmax attention, ring attention, Ulysses.
 
 The reference has no attention anywhere (SURVEY §2: the model zoo is an
 attention-free MLP, `/root/reference/shallowspeed/layers.py:236-270`), so this
@@ -25,7 +25,17 @@ is first-class in this framework, built the TPU way:
   ppermute hops of K/V) and the attention itself is the single fused XLA
   program — the better choice when heads >= devices and T is moderate.
 
-Both are differentiable with `jax.grad` (the transformer family uses JAX
+All three accept **GQA-shaped inputs natively**: k/v may carry
+`n_kv_heads < n_heads` heads and repeated K/V is never materialized —
+the score einsum groups query heads over the shared kv head. For the
+ring this also shrinks the rotating K/V blocks (ICI traffic) by the
+group factor; for Ulysses it shrinks the k/v all-to-alls the same way
+(requires n_kv_heads % axis_size == 0).
+
+All three accept `window > 0` — sliding-window (local) attention with
+identical semantics everywhere: position i sees keys [i-window+1, i].
+
+All are differentiable with `jax.grad` (the transformer family uses JAX
 autodiff as its autograd, unlike the MLP family's hand-written VJPs that
 mirror the reference's manual backprop layer).
 """
@@ -36,69 +46,98 @@ import jax
 import jax.numpy as jnp
 from jax import Array, lax
 
-_NEG = jnp.float32(-1e30)
+# plain float, NOT jnp.float32: a module-level jnp constant would
+# initialize the XLA backend at import time, which forbids a later
+# `jax.distributed.initialize` (multi-controller runs import this
+# package before calling `distributed.initialize`)
+_NEG = -1e30
+
+
+def _group(q: Array, kvh: int):
+    """(B, T, H, D) -> (B, T, Hkv, G, D): split query heads into GQA
+    groups over the kv head they share (head h uses kv head h // G)."""
+    b, t, h, d = q.shape
+    return q.reshape(b, t, kvh, h // kvh, d)
 
 
 def attention(q: Array, k: Array, v: Array, causal: bool = True,
               window: int = 0) -> Array:
     """Multi-head scaled-dot-product attention.
 
-    q, k, v: (batch, seq, heads, head_dim). Returns (batch, seq, heads,
-    head_dim). With `causal`, position i attends to positions <= i;
-    `window > 0` additionally restricts attention to the last `window`
-    positions (sliding-window / local attention, Mistral-style: position
-    i sees [i - window + 1, i]).
+    q: (batch, seq, heads, head_dim); k, v: (batch, seq, kv_heads,
+    head_dim) with kv_heads | heads (kv_heads < heads = native GQA).
+    Returns (batch, seq, heads, head_dim). With `causal`, position i
+    attends to positions <= i; `window > 0` additionally restricts
+    attention to the last `window` positions (sliding-window / local
+    attention, Mistral-style: position i sees [i - window + 1, i]).
 
     Mixed-precision safe: scores accumulate in float32 on the MXU
     (`preferred_element_type`) and the softmax runs in float32 regardless
     of the input dtype; only the probability @ V matmul runs in the input
     dtype. With float32 inputs every cast is a no-op.
     """
-    scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    b, tq, h, d = q.shape
+    kvh = k.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    qg = _group(q, kvh)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                    preferred_element_type=jnp.float32) * scale
     if causal or window > 0:
-        tq, tk = q.shape[1], k.shape[1]
+        tk = k.shape[1]
         iq, ik = jnp.arange(tq)[:, None], jnp.arange(tk)[None, :]
         mask = iq >= ik if causal else jnp.ones((tq, tk), bool)
         if window > 0:
             mask = mask & (ik > iq - window)
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    return out.reshape(b, tq, h, d).astype(q.dtype)
+
+
+attention.supports_gqa = True
+attention.supports_window = True
 
 
 def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,
-                      causal: bool = True, use_flash: bool = False) -> Array:
+                      causal: bool = True, window: int = 0,
+                      use_flash: bool = False) -> Array:
     """All-to-all (Ulysses) attention over the sequence-sharded `axis_name`.
 
     q, k, v: (batch, seq_local, heads, head_dim) — this device's sequence
-    block, same contract as `ring_attention`. Returns this device's
-    (batch, seq_local, heads, head_dim) output, equal (up to float
-    reassociation) to slicing full `attention` over the gathered sequence.
+    block, same contract as `ring_attention` (k/v may carry fewer GQA kv
+    heads; then n_kv_heads % axis_size == 0 is required). Returns this
+    device's (batch, seq_local, heads, head_dim) output, equal (up to
+    float reassociation) to slicing full `attention` over the gathered
+    sequence.
 
     The first all-to-all turns the sequence sharding into a *head* sharding
     (each device receives every sequence block for heads
     [idx*h/n, (idx+1)*h/n)); `tiled=True` concatenates received blocks in
     mesh-axis order, so the gathered sequence axis is already in global
-    order and the plain causal mask is correct. After local full attention,
-    the reverse all-to-all restores sequence sharding.
+    order and the plain causal/window mask is correct. Under GQA the head
+    split preserves group structure: device s's query heads
+    [s*h/n, (s+1)*h/n) are exactly the groups of its kv heads
+    [s*kvh/n, (s+1)*kvh/n). After local full attention, the reverse
+    all-to-all restores sequence sharding.
 
     `use_flash` swaps the local attention for the fused Pallas flash
     kernel (`ops/flash_attention.py`): because each device holds the FULL
-    gathered sequence for its head subset, the kernel's standard causal
-    mask applies unchanged — sequence parallelism and the flash kernel
-    compose with no kernel modifications (unlike the ring formulation,
-    which would need cross-block position-offset masking inside the
-    kernel).
+    gathered sequence for its head subset, the kernel's standard
+    causal/window mask applies unchanged — sequence parallelism, sliding
+    windows, GQA, and the flash kernel all compose with no kernel
+    modifications (unlike the ring formulation, which needs cross-block
+    position-offset masking inside its online-softmax loop).
     """
     n = lax.psum(1, axis_name)
-    h = q.shape[2]
+    h, kvh = q.shape[2], k.shape[2]
     assert h % n == 0, (
         f"ulysses_attention needs heads ({h}) divisible by the "
         f"'{axis_name}' axis size ({n}); use ring_attention otherwise")
+    assert kvh % n == 0, (
+        f"ulysses_attention with GQA needs kv_heads ({kvh}) divisible by "
+        f"the '{axis_name}' axis size ({n}); use ring_attention otherwise")
 
     def gather_seq(x):  # (b, t/n, h, d) -> (b, t, h/n, d)
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -108,35 +147,46 @@ def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,
         from shallowspeed_tpu.ops.flash_attention import flash_attention
 
         o = flash_attention(gather_seq(q), gather_seq(k), gather_seq(v),
-                            causal=causal)
+                            causal=causal, window=window)
     else:
         o = attention(gather_seq(q), gather_seq(k), gather_seq(v),
-                      causal=causal)
+                      causal=causal, window=window)
     # (b, t, h/n, d) -> (b, t/n, h, d)
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
 
 
+ulysses_attention.supports_gqa = True
+ulysses_attention.supports_window = True
+
+
 def ring_attention(q: Array, k: Array, v: Array, axis_name: str,
-                   causal: bool = True) -> Array:
+                   causal: bool = True, window: int = 0) -> Array:
     """Blockwise ring attention over the sequence-sharded `axis_name`.
 
     q, k, v: (batch, seq_local, heads, head_dim) — this device's sequence
     block; the global sequence is the concatenation of blocks in mesh-axis
-    order. Returns this device's (batch, seq_local, heads, head_dim) output,
-    equal (up to float reassociation) to slicing full `attention` over the
-    gathered sequence.
+    order (k/v may carry fewer GQA kv heads — the rotating blocks then
+    shrink by the group factor). Returns this device's (batch, seq_local,
+    heads, head_dim) output, equal (up to float reassociation) to slicing
+    full `attention` over the gathered sequence.
 
     Ring step i processes the K/V block originating at device
     `(idx - i) mod n` while `ppermute` forwards the in-flight block to the
     right neighbor; the online softmax state (running max m, normalizer l,
-    unnormalized out o) makes the result order-independent.
+    unnormalized out o) makes the result order-independent. `window > 0`
+    masks by global positions, so sliding windows compose with sequence
+    sharding unchanged (blocks entirely outside every query's window
+    contribute zero via the masked online-softmax update).
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t, h, d = q.shape
+    kvh = k.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    g = h // kvh
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
-    q32 = q.astype(jnp.float32)
+    q32 = _group(q.astype(jnp.float32), kvh)  # (b, t, kvh, g, d)
 
     qpos = idx * t + jnp.arange(t)  # global positions of this block's queries
     # K/V travel right one hop per step => step i sees the block of
@@ -145,12 +195,16 @@ def ring_attention(q: Array, k: Array, v: Array, axis_name: str,
     def step(carry, i):
         o, m, l, kb, vb = carry
         src = (idx - i) % n
-        s = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32)) * scale
-        if causal:
-            kpos = src * t + jnp.arange(t)
-            mask = qpos[:, None] >= kpos[None, :]        # (tq, tk)
-            s = jnp.where(mask[None, None], s, _NEG)
-            valid = mask[None, None]
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", q32,
+                       kb.astype(jnp.float32)) * scale
+        kpos = src * t + jnp.arange(t)
+        if causal or window > 0:
+            mask = (qpos[:, None] >= kpos[None, :] if causal
+                    else jnp.ones((t, t), bool))
+            if window > 0:
+                mask = mask & (kpos[None, :] > qpos[:, None] - window)
+            s = jnp.where(mask[None, None, None], s, _NEG)
+            valid = jnp.broadcast_to(mask[None, None, None], s.shape)
         else:
             valid = jnp.ones(s.shape, bool)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
@@ -159,10 +213,10 @@ def ring_attention(q: Array, k: Array, v: Array, axis_name: str,
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        # o layout is (b, t, h, d); alpha is (b, h, t, 1) -> align axes
-        alpha_o = alpha[..., 0].transpose(0, 2, 1)[..., None]  # (b, t, h, 1)
+        # o layout is (b, t, kvh, g, d); alpha is (b, kvh, g, t, 1) -> align
+        alpha_o = alpha[..., 0].transpose(0, 3, 1, 2)[..., None]
         o_new = o * alpha_o + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+            "bhgqk,bkhd->bqhgd", p, vb.astype(jnp.float32))
         perm = [(j, (j + 1) % n) for j in range(n)]
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
@@ -173,9 +227,13 @@ def ring_attention(q: Array, k: Array, v: Array, axis_name: str,
     # carries q's variance) handles any enclosing mesh (dp, sp, ...) without
     # naming axes here.
     zq = q32.sum() * 0.0
-    o0 = jnp.zeros((b, t, h, d), jnp.float32) + zq
-    m0 = jnp.full((b, h, t, 1), _NEG) + zq
-    l0 = jnp.zeros((b, h, t, 1), jnp.float32) + zq
+    o0 = jnp.zeros((b, t, kvh, g, d), jnp.float32) + zq
+    m0 = jnp.full((b, kvh, g, t, 1), _NEG) + zq
+    l0 = jnp.zeros((b, kvh, g, t, 1), jnp.float32) + zq
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
-    l_o = l[..., 0].transpose(0, 2, 1)[..., None]  # (b, t, h, 1)
-    return (o / jnp.maximum(l_o, 1e-30)).astype(q.dtype)
+    l_o = l[..., 0].transpose(0, 3, 1, 2)[..., None]  # (b, t, kvh, g, 1)
+    return (o / jnp.maximum(l_o, 1e-30)).reshape(b, t, h, d).astype(q.dtype)
+
+
+ring_attention.supports_gqa = True
+ring_attention.supports_window = True

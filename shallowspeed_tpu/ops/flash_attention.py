@@ -5,10 +5,10 @@ the naive `ops.attention` into einsum+softmax+einsum with the full (T, T)
 score matrix materialized in HBM; this kernel computes attention blockwise in
 VMEM with an online softmax (the FlashAttention-2 formulation), so HBM
 traffic is O(T·D) instead of O(T²) and the MXU stays fed from on-chip
-memory. Three kernels:
+memory.
 
 All three kernels share one streaming structure: a 3-D grid
-(batch·head, out-block, reduction-block) whose INNERMOST axis is the
+(batch·kv-head, out-block, reduction-block) whose INNERMOST axis is the
 reduction, so VMEM holds one (block_q, block_k) tile's operands at a time
 — per-step VMEM is O(block²), independent of sequence length:
 
@@ -25,12 +25,29 @@ reduction, so VMEM holds one (block_q, block_k) tile's operands at a time
 Every entry point picks between this streaming form and a resident fast
 path (whole K/V — or Q/dO/stats for dkv — held in VMEM with a fori_loop
 reduction) when the sequence fits `_RESIDENT_BYTES`; resident is ~10%
-faster at T=8k (no per-tile scratch round-trips) and its causal loop
-bounds skip masked tiles' DMA entirely. In the streaming form, causal
-masking drops fully-masked tiles' COMPUTE with `pl.when` (whole-tile
-Mosaic predication) but the grid still visits them, so their block DMA
-traffic is not saved — the FLOP savings of the old loop bounds are kept,
-the bandwidth savings only on the resident path.
+faster at T=8k (no per-tile scratch round-trips) and its causal/window
+loop bounds skip masked tiles' DMA entirely. In the streaming form,
+masked-out tiles skip their COMPUTE with `pl.when` (whole-tile Mosaic
+predication) but the grid still visits them, so their block DMA traffic
+is not saved — the FLOP savings of the old loop bounds are kept, the
+bandwidth savings only on the resident path.
+
+**Sliding windows** (`window > 0`): position i sees keys
+[i - window + 1, i] — identical semantics to `ops.attention`'s
+`window=` mask. Out-of-window k-tiles are skipped exactly like causal
+future tiles: shrunk fori_loop bounds on the resident paths (their DMA
+never issues), `pl.when` tile-liveness on the streaming paths. A long
+sequence with a small window therefore costs O(T·window), not O(T²).
+
+**Grouped-query attention** is native: pass k/v with fewer heads
+(n_kv_heads) than q and the kernels never materialize repeated K/V.
+Group folding maps GQA onto the exact same kernel bodies: q's heads
+fold as extra ROWS — (B, T, H, D) -> (B·Hkv, G·T, D) with each
+G-chunk of rows one query head sharing that kv head — so every q-row
+block attends against the SAME resident/streamed K/V tile, which is
+precisely the reuse GQA exists to exploit. Kernels recover logical
+positions as `row mod T` (blocks never straddle chunks since
+block_q | T). MHA is the G=1 special case — one code path.
 
 Wrapped in `jax.custom_vjp`, so `jax.grad` through the transformer uses the
 fused backward. On non-TPU backends the kernels run in Pallas interpret mode
@@ -60,9 +77,6 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-# ----------------------------------------------------------------- forward
-
-
 # Resident-K/V fast path bound: with tk*d at or under this, the whole K and
 # V comfortably fit VMEM next to the working blocks, and the single-kernel
 # fori_loop formulation avoids the streaming version's per-tile scratch
@@ -73,24 +87,63 @@ def _interpret_default() -> bool:
 _RESIDENT_BYTES = 1 << 20  # 1MB per whole-sequence operand held in VMEM
 
 
+def _mask(s, qrow, kcol, causal, window):
+    """Apply the causal and/or sliding-window mask to a score tile.
+    Returns (masked scores, validity mask or None)."""
+    valid = None
+    if causal:
+        valid = qrow >= kcol
+    if window > 0:
+        wv = kcol > qrow - window
+        valid = wv if valid is None else valid & wv
+    if valid is not None:
+        s = jnp.where(valid, s, _NEG)
+    return s, valid
+
+
+def _kblock_bounds(iqm, block_q, block_k, nkb, causal, window):
+    """fori_loop bounds over k-blocks for the q block with chunk-local
+    index `iqm` (resident fwd/dq paths). Tiles outside [lo, hi) contain
+    no unmasked entry — their DMA is never issued."""
+    lo = 0
+    hi = nkb
+    if causal:
+        hi = jnp.minimum(nkb, (iqm * block_q + block_q - 1) // block_k + 1)
+    if window > 0:
+        first_col = jnp.maximum(0, iqm * block_q - (window - 1))
+        lo = first_col // block_k
+    return lo, hi
+
+
+def _tile_live(iqm, jk, block_q, block_k, causal, window):
+    """Whether the (iqm, jk) tile has any unmasked entry (streaming
+    paths' `pl.when` predicate). `iqm` is the chunk-local q-block index."""
+    live = True
+    if causal:  # last q row >= first k col
+        live = (iqm * block_q + block_q - 1) >= (jk * block_k)
+    if window > 0:  # last k col inside the earliest row's window
+        wlive = (jk * block_k + block_k - 1) >= (iqm * block_q - (window - 1))
+        live = wlive if live is True else live & wlive
+    return live
+
+
+# ----------------------------------------------------------------- forward
+
+
 def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                         causal, block_q, block_k, seq_k):
+                         causal, window, block_q, block_k, seq_k,
+                         nqb_chunk):
     """Grid (bh, nqb): whole K/V resident in VMEM, fori_loop over k-blocks
     with the online-softmax carry in registers. Fast path for small T."""
     iq = pl.program_id(1)
+    iqm = iq % nqb_chunk  # chunk-local block index (GQA row folding)
     q = q_ref[:].astype(jnp.float32)                       # (bq, D)
     d = q.shape[-1]
 
     nkb = seq_k // block_k
-    if causal:
-        # q rows of this block end at global row iq*bq + bq - 1; k blocks
-        # strictly past that are fully masked — shrink the loop bound.
-        last = (iq * block_q + block_q - 1) // block_k
-        nkb_eff = jnp.minimum(nkb, last + 1)
-    else:
-        nkb_eff = nkb
+    lo, hi = _kblock_bounds(iqm, block_q, block_k, nkb, causal, window)
 
-    qrow = iq * block_q + jax.lax.broadcasted_iota(
+    qrow = iqm * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     def body(j, carry):
@@ -98,14 +151,12 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         kb = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         vb = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            kcol = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = qrow >= kcol
-            s = jnp.where(valid, s, _NEG)
+        kcol = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s, valid = _mask(s, qrow, kcol, causal, window)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if valid is not None:
             p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
@@ -116,7 +167,7 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     m0 = jnp.full((block_q, 1), _NEG)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nkb_eff, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m0, l0, acc0))
 
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     lse_ref[:] = jnp.broadcast_to(
@@ -124,15 +175,17 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, nkb):
+                *, scale, causal, window, block_q, block_k, nkb,
+                nqb_chunk):
     """Grid (bh, nqb, nkb) — the K reduction is the INNERMOST grid axis,
     so VMEM holds one (block_q, block_k)-tile's operands at a time; the
     online-softmax state (m, l, acc) lives in scratch that persists
     across the sequential innermost steps, and the (bh, iq) output block
-    is finalized at the last K step. Fully-masked causal tiles skip their
-    matmuls via `pl.when` (replacing the old shrunk fori_loop bound)."""
+    is finalized at the last K step. Fully-masked causal/window tiles
+    skip their matmuls via `pl.when`."""
     iq = pl.program_id(1)
     jk = pl.program_id(2)
+    iqm = iq % nqb_chunk
 
     @pl.when(jk == 0)
     def _init():
@@ -140,9 +193,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    live = True
-    if causal:  # tile with no unmasked entry: last q row < first k col
-        live = (iq * block_q + block_q - 1) >= (jk * block_k)
+    live = _tile_live(iqm, jk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _accum():
@@ -150,18 +201,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         kb = k_ref[:].astype(jnp.float32)                  # (bk, D)
         vb = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            qrow = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kcol = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = qrow >= kcol
-            s = jnp.where(valid, s, _NEG)
+        qrow = iqm * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kcol = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s, valid = _mask(s, qrow, kcol, causal, window)
         m = m_scr[:, 0:1]
         l = l_scr[:, 0:1]
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if valid is not None:
             p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
@@ -184,10 +233,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dq_ref, *, scale, causal, block_q, block_k, seq_k):
+                        dq_ref, *, scale, causal, window, block_q, block_k,
+                        seq_k, nqb_chunk):
     """Grid (bh, nqb): whole K/V resident in VMEM, fori_loop over k-blocks
-    with a shrunk causal bound. Fast path for small T."""
+    with shrunk causal/window bounds. Fast path for small T."""
     iq = pl.program_id(1)
+    iqm = iq % nqb_chunk
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
     lse = lse_ref[:, 0:1]
@@ -195,38 +246,35 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     d = q.shape[-1]
 
     nkb = seq_k // block_k
-    if causal:
-        last = (iq * block_q + block_q - 1) // block_k
-        nkb_eff = jnp.minimum(nkb, last + 1)
-    else:
-        nkb_eff = nkb
+    lo, hi = _kblock_bounds(iqm, block_q, block_k, nkb, causal, window)
 
-    qrow = iq * block_q + jax.lax.broadcasted_iota(
+    qrow = iqm * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     def body(j, dq):
         kb = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         vb = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            kcol = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qrow >= kcol, s, _NEG)
+        kcol = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s, _valid = _mask(s, qrow, kcol, causal, window)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(
-        0, nkb_eff, body, jnp.zeros((block_q, d), jnp.float32))
+        lo, hi, body, jnp.zeros((block_q, d), jnp.float32))
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dk_ref, dv_ref, *, scale, causal, block_q,
-                         block_k, seq_q):
-    """Grid (bh, nkb): whole Q/dO/stats resident in VMEM, fori_loop from
-    the first live q-block. Fast path for small T — the stats are
+                         dk_ref, dv_ref, *, scale, causal, window, block_q,
+                         block_k, seq_q, nqb_chunk, groups):
+    """Grid (bh, nkb): whole Q/dO/stats resident in VMEM; for each of the
+    `groups` query-head chunks (GQA row folding; static unroll), a
+    fori_loop from that chunk's first live q-block accumulates into the
+    SHARED dk/dv block. Fast path for small T — the stats are
     (T, 128)-lane f32, so this path's VMEM grows 512B/row and is gated
     tighter than the forward's."""
     jk = pl.program_id(1)
@@ -234,60 +282,70 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     vb = v_ref[:].astype(jnp.float32)
     d = kb.shape[-1]
 
-    nqb = seq_q // block_q
-    if causal:
-        # q blocks strictly before this k block are fully masked
-        first = (jk * block_k) // block_q
+    # chunk-local q-block bounds: blocks before `first` (causal) or past
+    # `last` (window) contain no unmasked entry for this k block
+    first = (jk * block_k) // block_q if causal else 0
+    if window > 0:
+        last = jnp.minimum(
+            nqb_chunk,
+            (jk * block_k + block_k - 1 + window - 1) // block_q + 1)
     else:
-        first = 0
+        last = nqb_chunk
 
     kcol = jk * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q), 0:1]
-        delta = delta_ref[pl.ds(i * block_q, block_q), 0:1]
-        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
+    def chunk_body(base, carry):
+        # `base` = this chunk's first block index in the folded row space
+        def body(i, carry):
+            dk, dv = carry
+            row0 = (base + i) * block_q
+            qb = q_ref[pl.ds(row0, block_q), :].astype(jnp.float32)
+            dob = do_ref[pl.ds(row0, block_q), :].astype(jnp.float32)
+            lse = lse_ref[pl.ds(row0, block_q), 0:1]
+            delta = delta_ref[pl.ds(row0, block_q), 0:1]
+            s = jnp.dot(qb, kb.T,
+                        preferred_element_type=jnp.float32) * scale
             qrow = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(qrow >= kcol, s, _NEG)
-        p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
-        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
-        return dk, dv
+            s, _valid = _mask(s, qrow, kcol, causal, window)
+            p = jnp.exp(s - lse)
+            dv = dv + jnp.dot(p.T, dob,
+                              preferred_element_type=jnp.float32)
+            dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * scale
+            dk = dk + jnp.dot(ds.T, qb,
+                              preferred_element_type=jnp.float32)
+            return dk, dv
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first, nqb, body, (dk0, dv0))
+        return jax.lax.fori_loop(first, last, body, carry)
+
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    for gi in range(groups):  # static: groups is a compile-time constant
+        dk, dv = chunk_body(gi * nqb_chunk, (dk, dv))
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_q, block_k):
+               scale, causal, window, block_q, block_k, nqb_chunk):
     """Grid (bh, nqb, nkb) — the K reduction runs as the INNERMOST grid
     axis so VMEM holds one (block_q, block_k)-tile's operands at a time
     (the previous whole-sequence block specs hit the scoped-vmem ceiling
     at T≈8k); dq_ref is the (bh, iq) block, revisited across j, f32
-    accumulated. Fully-masked causal tiles skip their matmuls via
+    accumulated. Fully-masked causal/window tiles skip their matmuls via
     `pl.when` (Mosaic predication), preserving the old loop-bound
     optimization."""
     iq = pl.program_id(1)
     jk = pl.program_id(2)
+    iqm = iq % nqb_chunk
 
     @pl.when(jk == 0)
     def _init():
         dq_ref[:] = jnp.zeros_like(dq_ref)
 
-    live = True
-    if causal:  # tile with no unmasked entry: last q row < first k col
-        live = (iq * block_q + block_q - 1) >= (jk * block_k)
+    live = _tile_live(iqm, jk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _accum():
@@ -298,12 +356,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         kb = k_ref[:].astype(jnp.float32)
         vb = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            qrow = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kcol = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qrow >= kcol, s, _NEG)
+        qrow = iqm * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kcol = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s, _valid = _mask(s, qrow, kcol, causal, window)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -311,21 +368,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, block_k):
-    """Grid (bh, nkb, nqb) — Q reduction innermost, (bh, jk) output block
+                dk_ref, dv_ref, *, scale, causal, window, block_q,
+                block_k, nqb_chunk):
+    """Grid (bh, nkb, nqb_total) — Q reduction innermost (across ALL
+    query-group chunks under GQA, so group members' contributions
+    accumulate into the shared dk/dv block), (bh, jk) output block
     revisited across i with f32 accumulation; same VMEM story as
     `_dq_kernel`."""
     jk = pl.program_id(1)
     iq = pl.program_id(2)
+    iqm = iq % nqb_chunk
 
     @pl.when(iq == 0)
     def _init():
         dk_ref[:] = jnp.zeros_like(dk_ref)
         dv_ref[:] = jnp.zeros_like(dv_ref)
 
-    live = True
-    if causal:
-        live = (iq * block_q + block_q - 1) >= (jk * block_k)
+    live = _tile_live(iqm, jk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _accum():
@@ -336,12 +395,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[:, 0:1]
         delta = delta_ref[:, 0:1]
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            qrow = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kcol = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qrow >= kcol, s, _NEG)
+        qrow = iqm * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kcol = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s, _valid = _mask(s, qrow, kcol, causal, window)
         p = jnp.exp(s - lse)
         dv_ref[:] += jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
@@ -363,6 +421,26 @@ def _from_bhsd(x, b, h):
     return jnp.transpose(jnp.reshape(x, (b, h, t, d)), (0, 2, 1, 3))
 
 
+def _fold_q(x, kvh):
+    """GQA row folding: (B, T, H, D) -> (B*Hkv, G*T, D) where query head
+    h = kv*G + g lands in rows [g*T, (g+1)*T) of batch-row b*Hkv + kv —
+    each G-chunk of rows is one query head sharing that kv head."""
+    b, t, h, d = x.shape
+    g = h // kvh
+    x = jnp.transpose(x, (0, 2, 1, 3))          # (B, H, T, D)
+    return jnp.reshape(x, (b * kvh, g * t, d))  # heads split as (kvh, g)
+
+
+def _unfold_q(x, b, h):
+    """Inverse of `_fold_q`: (B*Hkv, G*T, D) -> (B, T, H, D)."""
+    bkv, gt, d = x.shape
+    kvh = bkv // b
+    g = h // kvh
+    x = jnp.reshape(x, (b, kvh, g, gt // g, d))
+    x = jnp.reshape(x, (b, h, gt // g, d))
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
 def _pick_block(t: int, want: int) -> int:
     while t % want:
         want //= 2
@@ -379,42 +457,60 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True, window: int = 0,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
     """Fused multi-head attention; same contract as `ops.attention`.
 
-    q, k, v: (batch, seq, heads, head_dim) -> (batch, seq, heads, head_dim).
-    Sequence lengths must be divisible by the (auto-shrunk) block sizes.
+    q: (batch, seq, heads, head_dim); k, v: (batch, seq, kv_heads,
+    head_dim) with kv_heads | heads — kv_heads < heads is native GQA (no
+    repeated K/V is ever materialized). Returns (batch, seq, heads,
+    head_dim). `window > 0` restricts position i to keys
+    [i - window + 1, i] (sliding-window attention; out-of-window tiles
+    are skipped, not just masked). Sequence lengths must be divisible by
+    the (auto-shrunk) block sizes.
     `interpret=None` auto-selects Pallas interpret mode off-TPU.
     """
-    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    o, _ = _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+flash_attention.supports_gqa = True
+flash_attention.supports_window = True
+
+
+def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
     if interpret is None:
         interpret = _interpret_default()
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    kvh = k.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    g = h // kvh
     bq = _pick_block(tq, block_q)
     bk = _pick_block(tk, block_k)
+    nqb_chunk = tq // bq
     scale = 1.0 / float(np.sqrt(d))
+    window = int(window)
 
-    q3, k3, v3 = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
-    bh = b * h
+    q3 = _fold_q(q, kvh)                         # (b*kvh, g*tq, d)
+    k3, v3 = _to_bhsd(k), _to_bhsd(v)            # (b*kvh, tk, d)
+    bh = b * kvh
+    rows = g * tq
 
     out_shape = [
-        _sds((bh, tq, d), q.dtype, q3),
-        _sds((bh, tq, _LANES), jnp.float32, q3),
+        _sds((bh, rows, d), q.dtype, q3),
+        _sds((bh, rows, _LANES), jnp.float32, q3),
     ]
     if tk * d * q.dtype.itemsize <= _RESIDENT_BYTES:
         kernel = functools.partial(
-            _fwd_kernel_resident, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, seq_k=tk)
+            _fwd_kernel_resident, scale=scale, causal=causal,
+            window=window, block_q=bq, block_k=bk, seq_k=tk,
+            nqb_chunk=nqb_chunk)
         o3, lse = pl.pallas_call(
             kernel,
-            grid=(bh, tq // bq),
+            grid=(bh, rows // bq),
             in_specs=[
                 pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
@@ -430,11 +526,12 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     else:
         from jax.experimental.pallas import tpu as pltpu
 
-        kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                                   block_q=bq, block_k=bk, nkb=tk // bk)
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, window=window,
+            block_q=bq, block_k=bk, nkb=tk // bk, nqb_chunk=nqb_chunk)
         o3, lse = pl.pallas_call(
             kernel,
-            grid=(bh, tq // bq, tk // bk),
+            grid=(bh, rows // bq, tk // bk),
             in_specs=[
                 pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
                 pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
@@ -453,27 +550,34 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             ],
             interpret=interpret,
         )(q3, k3, v3)
-    return _from_bhsd(o3, b, h), (q, k, v, _from_bhsd(o3, b, h), lse)
+    o = _unfold_q(o3, b, h)
+    return o, (q, k, v, o, lse)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    o, res = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd_rule(q, k, v, causal, window, block_q, block_k, interpret):
+    o, res = _flash_fwd(q, k, v, causal, window, block_q, block_k,
+                        interpret)
     return o, res
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(causal, window, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
     if interpret is None:
         interpret = _interpret_default()
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    kvh = k.shape[2]
+    g = h // kvh
     bq = _pick_block(tq, block_q)
     bk = _pick_block(tk, block_k)
+    nqb_chunk = tq // bq
     scale = 1.0 / float(np.sqrt(d))
-    bh = b * h
+    window = int(window)
+    bh = b * kvh
+    rows = g * tq
 
-    q3, k3, v3 = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
-    o3, do3 = _to_bhsd(o), _to_bhsd(do)
+    q3, k3, v3 = _fold_q(q, kvh), _to_bhsd(k), _to_bhsd(v)
+    o3, do3 = _fold_q(o, kvh), _fold_q(do, kvh)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term,
     # broadcast across the 128-lane stats dim like lse
     delta = jnp.broadcast_to(
@@ -489,11 +593,11 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
     dq_resident = tk * d * q.dtype.itemsize <= _RESIDENT_BYTES
     if dq_resident:
         dq_kernel = functools.partial(
-            _dq_kernel_resident, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, seq_k=tk)
+            _dq_kernel_resident, scale=scale, causal=causal, window=window,
+            block_q=bq, block_k=bk, seq_k=tk, nqb_chunk=nqb_chunk)
         dq3 = pl.pallas_call(
             dq_kernel,
-            grid=(bh, tq // bq),
+            grid=(bh, rows // bq),
             in_specs=[
                 pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
@@ -503,15 +607,16 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
                 pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
             ],
             out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            out_shape=_sds((bh, tq, d), jnp.float32, q3),
+            out_shape=_sds((bh, rows, d), jnp.float32, q3),
             interpret=interpret,
         )(q3, k3, v3, do3, lse, delta)
     else:
-        dq_kernel = functools.partial(_dq_kernel, scale=scale,
-                                      causal=causal, block_q=bq, block_k=bk)
+        dq_kernel = functools.partial(
+            _dq_kernel, scale=scale, causal=causal, window=window,
+            block_q=bq, block_k=bk, nqb_chunk=nqb_chunk)
         dq3 = pl.pallas_call(
             dq_kernel,
-            grid=(bh, tq // bq, tk // bk),
+            grid=(bh, rows // bq, tk // bk),
             in_specs=[
                 pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
                 pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
@@ -524,29 +629,32 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
             ],
             out_specs=pl.BlockSpec((None, bq, d),
                                    lambda i, j, k_: (i, j, 0)),
-            out_shape=_sds((bh, tq, d), jnp.float32, q3),
+            out_shape=_sds((bh, rows, d), jnp.float32, q3),
             interpret=interpret,
         )(q3, k3, v3, do3, lse, delta)
 
     # lse/delta stats are always f32 and get a deliberate 2x allowance
-    # (preserves the pre-byte-gate bound: bf16 resident up to T=4096)
-    stats_bytes = tq * _LANES * jnp.dtype(jnp.float32).itemsize
-    dkv_resident = (tq * d * q.dtype.itemsize <= _RESIDENT_BYTES
+    # (preserves the pre-byte-gate bound: bf16 resident up to T=4096).
+    # Under GQA the folded row space is g*tq long and the WHOLE folded
+    # Q/dO/stats must sit in VMEM, so both gates are absolute in `rows`.
+    stats_bytes = rows * _LANES * jnp.dtype(jnp.float32).itemsize
+    dkv_resident = (rows * d * q.dtype.itemsize <= _RESIDENT_BYTES
                     and stats_bytes <= 2 * _RESIDENT_BYTES)
     if dkv_resident:
         dkv_kernel = functools.partial(
-            _dkv_kernel_resident, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, seq_q=tq)
+            _dkv_kernel_resident, scale=scale, causal=causal,
+            window=window, block_q=bq, block_k=bk, seq_q=tq,
+            nqb_chunk=nqb_chunk, groups=g)
         dk3, dv3 = pl.pallas_call(
             dkv_kernel,
             grid=(bh, tk // bk),
             in_specs=[
-                pl.BlockSpec((None, tq, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, rows, d), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, tq, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, tq, _LANES), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, tq, _LANES), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, rows, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, rows, _LANES), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, rows, _LANES), lambda i, j: (i, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
@@ -559,12 +667,12 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
             interpret=interpret,
         )(q3, k3, v3, do3, lse, delta)
     else:
-        dkv_kernel = functools.partial(_dkv_kernel, scale=scale,
-                                       causal=causal, block_q=bq,
-                                       block_k=bk)
+        dkv_kernel = functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, window=window,
+            block_q=bq, block_k=bk, nqb_chunk=nqb_chunk)
         dk3, dv3 = pl.pallas_call(
             dkv_kernel,
-            grid=(bh, tk // bk, tq // bq),
+            grid=(bh, tk // bk, rows // bq),
             in_specs=[
                 pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, k_, 0)),
                 pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
@@ -586,9 +694,9 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
             interpret=interpret,
         )(q3, k3, v3, do3, lse, delta)
 
-    return (_from_bhsd(dq3, b, h).astype(q.dtype),
-            _from_bhsd(dk3, b, h).astype(k.dtype),
-            _from_bhsd(dv3, b, h).astype(v.dtype))
+    return (_unfold_q(dq3, b, h).astype(q.dtype),
+            _from_bhsd(dk3, b, kvh).astype(k.dtype),
+            _from_bhsd(dv3, b, kvh).astype(v.dtype))
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

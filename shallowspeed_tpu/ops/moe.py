@@ -47,9 +47,20 @@ def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
                 gate weight on expert e's slot c (0 if not routed there).
       dispatch: (G, S, E, C) bool — nonzero support of `combine`.
       aux:      scalar load-balancing loss (Switch formulation).
+      stats:    {"load": (E,) f32 — fraction of (token, k) assignments
+                routed to each expert (pre-drop; sums to 1),
+                "drop_fraction": scalar f32 — fraction of assignments
+                dropped for capacity}. Routing is stop-gradiented by
+                construction here (top_k indices), so consumers may log
+                these without touching the loss; unused stats are
+                dead-code-eliminated by XLA.
 
     Tokens beyond an expert's capacity are dropped for that expert (their
     gate weight contributes nothing) — the standard static-shape tradeoff.
+    The drop is SILENT in the loss (the renormalized gate mass simply
+    never reaches an expert), which is exactly why `stats` exists: a
+    capacity_factor too low for the current routing entropy shows up as
+    drop_fraction, not as an error.
     Positions are assigned in sequence order per expert, with later k
     choices stacked after all earlier-k assignments (GShard's ordering).
     """
@@ -62,6 +73,8 @@ def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
 
     combine = jnp.zeros((g, s, e, capacity), jnp.float32)
     used = jnp.zeros((g, e), jnp.float32)  # slots consumed by earlier k
+    kept = jnp.float32(0.0)
+    assigned = jnp.zeros((e,), jnp.float32)  # pre-drop per-expert counts
     for k in range(top_k):
         onehot = jax.nn.one_hot(topk_idx[..., k], e)            # (G, S, E)
         # Position of each token within its expert's buffer: tokens assigned
@@ -73,13 +86,18 @@ def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
         combine = combine + (topk_gate[..., k, None, None]
                              * keep[..., None] * slot[:, :, None, :])
         used = used + keep.sum(axis=1)
+        kept = kept + keep.sum()
+        assigned = assigned + onehot.sum(axis=(0, 1))
     dispatch = combine > 0.0
 
     # Switch aux loss on the top-1 assignment: E * sum_e f_e * P_e, where
     # f_e = fraction of tokens whose first choice is e, P_e = mean prob.
     top1 = jax.nn.one_hot(topk_idx[..., 0], e)
     aux = e * jnp.sum(top1.mean(axis=(0, 1)) * probs.mean(axis=(0, 1)))
-    return combine, dispatch, aux
+    total = jnp.float32(g * s * top_k)
+    stats = {"load": assigned / total,
+             "drop_fraction": 1.0 - kept / total}
+    return combine, dispatch, aux, stats
 
 
 def router_z_loss(gate_logits: jax.Array) -> jax.Array:
@@ -96,8 +114,10 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
     p: {"gate": (d, E), "wi": (E, d, ff), "bi": (E, ff),
         "wo": (E, ff, d), "bo": (E, d)}
     x: (G, S, d) -> (y (G, S, d), balance-aux scalar, router z-loss
-    scalar) — both auxiliaries come back UNWEIGHTED; the model config
-    owns the weights (`moe_aux_weight`, `moe_z_weight`).
+    scalar, routing stats dict) — the auxiliaries come back UNWEIGHTED;
+    the model config owns the weights (`moe_aux_weight`, `moe_z_weight`).
+    `stats` (see `topk_capacity_routing`) is observability only — when a
+    caller drops it, XLA dead-code-eliminates its computation.
 
     The two routing einsums below are where expert parallelism happens: with
     `wi`/`wo` sharded `P('ep', ...)` and `x` sharded over batch, GSPMD turns
@@ -112,7 +132,8 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
     # topk_capacity_routing is f32 already).
     logits = jnp.einsum("gsd,de->gse", x, p["gate"],
                         preferred_element_type=jnp.float32)     # (G, S, E)
-    combine, dispatch, aux = topk_capacity_routing(logits, cap, top_k)
+    combine, dispatch, aux, stats = topk_capacity_routing(logits, cap,
+                                                          top_k)
 
     xin = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)
     h = jax.nn.gelu(jnp.einsum("egcd,edf->egcf", xin, p["wi"])
@@ -120,4 +141,4 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
     out = (jnp.einsum("egcf,efd->egcd", h, p["wo"])
            + p["bo"][:, None, None, :])
     y = jnp.einsum("gsec,egcd->gsd", combine.astype(x.dtype), out)
-    return y, aux, router_z_loss(logits)
+    return y, aux, router_z_loss(logits), stats

@@ -142,6 +142,21 @@ class _Optimizer:
             return clip_by_global_norm(grads, self.grad_clip, self.clip_axes)
         return grads
 
+    def map_state_trees(self, state: Any, fn) -> Any:
+        """Apply `fn` — a params-shaped-tree -> params-shaped-tree
+        transform (e.g. an engine's stack/unstack between its layout and
+        the canonical checkpoint layout) — to every params-shaped moment
+        tree inside `state`, passing scalars (step counters) through.
+
+        This is the seam that makes optimizer state engine-agnostic in
+        checkpoints (`checkpoint.py`): an engine that can re-layout its
+        params can re-layout exactly-params-shaped moments with the SAME
+        transform. Default: no params-shaped trees (stateless SGD).
+        Optimizers whose state is NOT params-shaped (Adafactor's factored
+        vr/vc) raise ValueError — callers fall back to re-initializing.
+        """
+        return state
+
 
 class SGD(_Optimizer):
     """Plain SGD. Reference: `optimizer.py:4-13`. Stateless with a static
@@ -191,6 +206,11 @@ class MomentumSGD(_Optimizer):
                        params, vel)
         return new, ({"v": vel, "t": t + 1} if sched else vel)
 
+    def map_state_trees(self, state: Any, fn) -> Any:
+        if isinstance(state, dict) and "v" in state:
+            return {"v": fn(state["v"]), "t": state["t"]}
+        return fn(state)
+
 
 class Adam(_Optimizer):
     """Adam (addition; matches the reference's PyTorch-DDP baseline script,
@@ -229,6 +249,9 @@ class Adam(_Optimizer):
                                          + wd * p)).astype(p.dtype),
             params, m, v)
         return new, {"m": m, "v": v, "t": t}
+
+    def map_state_trees(self, state: Any, fn) -> Any:
+        return {"m": fn(state["m"]), "v": fn(state["v"]), "t": state["t"]}
 
 
 class AdamW(Adam):
@@ -371,11 +394,22 @@ class Adafactor(_Optimizer):
                 m = self.beta1 * slot["m"] + (1 - self.beta1) * u
                 slot["m"] = m
                 u = m
-            upd = a * u + lr * self.weight_decay * p.astype(jnp.float32)
+            # decay with the same parameter-scaled step as the main
+            # update: under scale_parameter the schedule lr is a
+            # *relative* step size, so decay strength must track RMS(p)
+            # too or leaves with small/large RMS decay disproportionately
+            upd = a * u + a * self.weight_decay * p.astype(jnp.float32)
             new_p.append((p.astype(jnp.float32) - upd).astype(p.dtype))
             new_slots.append(slot)
         return (jax.tree_util.tree_unflatten(tdef, new_p),
                 {"slots": tuple(new_slots), "t": t})
+
+    def map_state_trees(self, state: Any, fn) -> Any:
+        raise ValueError(
+            "Adafactor state is factored (per-leaf vr/vc vectors keyed to "
+            "the flattened engine params), not params-shaped; it cannot "
+            "be re-laid-out by a params-tree transform. Engines whose "
+            "layout IS canonical interchange it directly.")
 
 
 OPTIMIZERS = {"sgd": SGD, "momentum": MomentumSGD, "adam": Adam,

@@ -58,6 +58,10 @@ class ContextParallelEngine:
       fastest single-device path on TPU.
     """
 
+    # params (hence params-shaped moments) are already in the canonical
+    # checkpoint layout; placement is not structure (checkpoint.py)
+    canonical_opt_identity = True
+
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  seed: int = 0, attn: str = "ring", zero1: bool = False,
                  zero2: bool = False, accum: int = 1):
@@ -77,26 +81,27 @@ class ContextParallelEngine:
         self.opt_state = jax.device_put(optimizer.init(self.params), self.rep)
 
         opt = optimizer
-        if cfg.attn_window > 0:
-            assert self.sp == 1 and attn == "ring", (
-                "attn_window composes with full XLA attention (sp=1); "
-                "the flash/ring/ulysses substrates do not window")
-            from shallowspeed_tpu.ops.attention import attention as _full
-
-            attn = partial(_full, causal=True, window=cfg.attn_window)
-        elif attn == "flash":
+        # Sliding windows compose with EVERY substrate: all of them take
+        # `window=` with identical semantics (`ops/attention.py` masks,
+        # the flash kernel skips out-of-window tiles outright).
+        w = cfg.attn_window
+        if attn == "flash":
             from shallowspeed_tpu.ops.flash_attention import flash_attention
 
             assert self.sp == 1, "--attn flash requires sp=1 (use ring)"
-            attn = partial(flash_attention, causal=True)
+            attn = partial(flash_attention, causal=True, window=w)
         elif attn in ("ulysses", "ulysses-flash"):
             assert cfg.n_heads % self.sp == 0, (
                 f"--attn {attn} needs n_heads ({cfg.n_heads}) divisible by "
                 f"sp ({self.sp}); use ring")
+            assert cfg.kv_heads % self.sp == 0, (
+                f"--attn {attn} with GQA needs n_kv_heads "
+                f"({cfg.kv_heads}) divisible by sp ({self.sp}); use ring")
             attn = partial(ulysses_attention, axis_name="sp", causal=True,
-                           use_flash=attn == "ulysses-flash")
+                           window=w, use_flash=attn == "ulysses-flash")
         else:
-            attn = partial(ring_attention, axis_name="sp", causal=True)
+            attn = partial(ring_attention, axis_name="sp", causal=True,
+                           window=w)
 
         sp = self.sp
 
@@ -133,7 +138,8 @@ class ContextParallelEngine:
             b, t = tokens.shape
             assert b % accum == 0, (
                 f"--accum {accum} must divide the per-device batch rows "
-                f"({b} here = batch / (dp * sp))")
+                f"({b} here = batch / dp; sp shards the sequence dim, "
+                f"not rows)")
             tok_r = tokens.reshape(accum, b // accum, t)
             tgt_r = targets.reshape(accum, b // accum, t)
 
@@ -234,6 +240,7 @@ class ContextParallelEngine:
             self._update_fn = make_zero1_update(
                 opt, self.params, self.opt_state)
             self._step_fn = None
+            self._run_fn = None
         elif zero1:
             from shallowspeed_tpu.parallel.zero import (
                 make_zero1_update, shard_state_zero1)
@@ -253,6 +260,7 @@ class ContextParallelEngine:
             self._update_fn = make_zero1_update(
                 opt, self.params, self.opt_state)
             self._step_fn = None
+            self._run_fn = None
         else:
 
             @partial(jax.jit, donate_argnums=(0, 1))
@@ -266,6 +274,31 @@ class ContextParallelEngine:
                 return params, opt_state, loss
 
             self._step_fn = _step
+
+            # Run fusion: a whole multi-step run as ONE XLA dispatch
+            # (`lax.scan` over optimizer steps, batches HBM-resident) —
+            # the transformer-family counterpart of the MLP engine's
+            # `train_run` (engine.py), and the honest way to measure
+            # steady-state throughput when per-dispatch latency (e.g. a
+            # tunneled backend) would otherwise pollute step timing.
+            @partial(jax.jit, donate_argnums=(0, 1))
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P(), P(), P(None, "dp", "sp"),
+                               P(None, "dp", "sp"), P()),
+                     out_specs=(P(), P(), P()))
+            def _run(params, opt_state, toks, tgts, step0):
+                def body(carry, xs):
+                    params, opt_state, step = carry
+                    tok, tgt = xs
+                    loss, grads = loss_and_grads(params, tok, tgt, step)
+                    params, opt_state = opt.step(params, grads, opt_state)
+                    return (params, opt_state, step + 1), loss
+
+                (params, opt_state, _), losses = jax.lax.scan(
+                    body, (params, opt_state, step0), (toks, tgts))
+                return params, opt_state, losses
+
+            self._run_fn = _run
 
         @jax.jit
         @partial(shard_map, mesh=mesh,
@@ -284,6 +317,24 @@ class ContextParallelEngine:
             off = jax.lax.axis_index("sp") * t_local
             return T.forward(params, tokens, cfg, attn_fn=attn,
                              pos_offset=off)
+
+        if cfg.n_experts > 0:
+            @jax.jit
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P(), P("dp", "sp")), out_specs=P())
+            def _router_stats(params, tokens):
+                t_local = tokens.shape[1]
+                off = jax.lax.axis_index("sp") * t_local
+                _, _aux, st = T.forward_with_aux(
+                    params, tokens, cfg, attn_fn=attn, pos_offset=off,
+                    with_stats=True)
+                # equal-sized tiles: pmean is the exact global average
+                return tree_map(lambda v: jax.lax.pmean(v, ("dp", "sp")),
+                                st)
+
+            self._router_stats_fn = _router_stats
+        else:
+            self._router_stats_fn = None
 
         self._eval_fn = _eval
         self._logits_fn = _logits
@@ -330,12 +381,44 @@ class ContextParallelEngine:
         """One optimizer step on a (B, T) int token batch; returns the loss."""
         return float(self.train_batch_async(tokens, targets))
 
+    def train_run(self, tokens: np.ndarray, targets: np.ndarray):
+        """S optimizer steps as ONE compiled dispatch. tokens/targets:
+        (S, B, T) int arrays, staged HBM-resident up front; returns the
+        (S,) per-step losses as a lazy device array. Dense engine only
+        (ZeRO-1/2 interleave a host-side sharded update per step)."""
+        assert self._run_fn is not None, (
+            "train_run needs the dense engine (zero1/zero2 step on the "
+            "host between grad programs)")
+        s, b, t = tokens.shape
+        assert t % self.sp == 0 and t <= self.cfg.max_seq, (t, self.sp)
+        assert (b * jax.process_count()) % self.dp == 0, (b, self.dp)
+        sharding = NamedSharding(self.mesh, P(None, "dp", "sp"))
+        toks = jax.device_put(np.asarray(tokens), sharding)
+        tgts = jax.device_put(np.asarray(targets), sharding)
+        step0 = np.uint32(self._step_count)
+        self._step_count += s
+        self.params, self.opt_state, losses = self._run_fn(
+            self.params, self.opt_state, toks, tgts, step0)
+        return losses
+
     def eval_loss(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         return float(self._eval_fn(
             self.params, self._place(tokens), self._place(targets)))
 
     def logits(self, tokens: np.ndarray) -> jax.Array:
         return self._logits_fn(self.params, self._place(tokens))
+
+    def router_stats(self, tokens) -> dict | None:
+        """MoE routing observability on one batch (see
+        `GSPMDEngine.router_stats`): per-expert assignment load (pre-drop)
+        and the dropped-assignment fraction, tile-averaged over the
+        (dp, sp) mesh. None for dense configs."""
+        if self._router_stats_fn is None:
+            return None
+        st = jax.device_get(
+            self._router_stats_fn(self.params, self._place(tokens)))
+        return {"expert_load": [round(float(x), 4) for x in st["load"]],
+                "drop_fraction": round(float(st["drop_fraction"]), 4)}
 
     # -------------------------------------------- checkpoint interface
 

@@ -26,6 +26,11 @@ class GSPMDEngine:
     """Data x model parallel trainer: batch over 'dp' (the first mesh
     axis), parameters placed per `self.param_specs(cfg)`."""
 
+    # this family's param LAYOUT is the canonical checkpoint layout
+    # (sharding is placement, not structure) — so its optimizer state
+    # interchanges engine-agnostically as-is (checkpoint.py)
+    canonical_opt_identity = True
+
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  seed: int = 0, zero1: bool = False, zero2: bool = False):
         assert not (zero1 and zero2), "zero2 subsumes zero1"
@@ -179,6 +184,22 @@ class GSPMDEngine:
 
     def logits(self, tokens: np.ndarray) -> jax.Array:
         return self._logits_fn(self.params, self._place(tokens))
+
+    def router_stats(self, tokens) -> dict | None:
+        """MoE routing observability on one batch: per-expert fraction of
+        (token, k) assignments (pre-drop) and the dropped-assignment
+        fraction — the numbers that make `ops/moe.py`'s silent capacity
+        drop visible. None for dense configs. Train-mode forward without
+        dropout; costs one extra forward, so call at log points only."""
+        if self.cfg.n_experts == 0:
+            return None
+        if not hasattr(self, "_stats_fn"):
+            self._stats_fn = jax.jit(lambda p, tok: T.forward_with_aux(
+                p, tok, self.cfg, with_stats=True)[2])
+        st = jax.device_get(
+            self._stats_fn(self.params, self._place(tokens)))
+        return {"expert_load": [round(float(x), 4) for x in st["load"]],
+                "drop_fraction": round(float(st["drop_fraction"]), 4)}
 
     # -------------------------------------------- checkpoint interface
 

@@ -217,14 +217,8 @@ class PipelineLMEngine:
             def psum_tp(x):
                 return x
 
-        if cfg.attn_window > 0:
-            assert self.attn == "xla", (
-                "attn_window needs XLA attention in the pipeline")
-
-            def attn_fn(q, k, v):
-                return attention(q, k, v, causal=True,
-                                 window=cfg.attn_window)
-        elif self.attn == "flash":
+        w = cfg.attn_window  # windows compose with both substrates
+        if self.attn == "flash":
             # the fused Pallas kernel drops into the stage block
             # unchanged: per-device heads, full (unsharded) microbatch
             # sequence — and its custom VJP composes with both backward
@@ -234,11 +228,11 @@ class PipelineLMEngine:
                 flash_attention)
 
             def attn_fn(q, k, v):
-                return flash_attention(q, k, v, causal=True)
+                return flash_attention(q, k, v, causal=True, window=w)
         else:
 
             def attn_fn(q, k, v):
-                return attention(q, k, v, causal=True)
+                return attention(q, k, v, causal=True, window=w)
 
         def mega_block(blk, x, key=None):
             """One pre-LN block on this device's tp shard: qkv/up columns
@@ -267,9 +261,8 @@ class PipelineLMEngine:
             if cfg.rope:  # sequence is unsharded here: positions 0..t
                 q = T.rope_rotate(q, jnp.arange(t), cfg.rope_theta)
                 k = T.rope_rotate(k, jnp.arange(t), cfg.rope_theta)
-            # group factor is tp-invariant (both head counts divide by tp)
-            k = T.repeat_kv(k, cfg)
-            v = T.repeat_kv(v, cfg)
+            # group factor is tp-invariant (both head counts divide by
+            # tp); both substrates consume unrepeated GQA heads natively
             a = attn_fn(q, k, v).reshape(b, t, heads_local * hd)
             x = x + T._dropout(
                 psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"],
@@ -610,6 +603,16 @@ class PipelineLMEngine:
                                    self.place(targets)))
 
     # -------------------------------------------- checkpoint interface
+
+    def canon_export_tree(self, tree):
+        """Params-shaped tree (e.g. Adam moments) -> canonical layout;
+        the SAME transform params take into a checkpoint."""
+        return unstack_blocks(jax.device_get(tree), self.cfg.n_layers)
+
+    def canon_import_tree(self, tree):
+        """Inverse of `canon_export_tree` (host-side; placement happens
+        in `set_opt_state`)."""
+        return stack_blocks(tree_map(np.asarray, tree))
 
     def get_canonical_params(self):
         return unstack_blocks(jax.device_get(self.params),

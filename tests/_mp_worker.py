@@ -1,0 +1,76 @@
+"""Worker process for the 2-process `jax.distributed` test
+(`test_distributed.py::test_two_process_training_agrees`).
+
+Each of the two OS processes hosts 2 virtual CPU devices and connects to
+the local coordinator — a real multi-controller runtime (the thing the
+reference gets from `mpirun -n N`, `/root/reference/train.py:87-94`),
+with the gradient psum crossing the process boundary over the JAX
+distributed service. Run: python _mp_worker.py <process_id> <port>.
+"""
+
+import os
+import sys
+
+import re
+
+# FORCE 2 local devices, replacing any inherited count (pytest's conftest
+# exports 8 into XLA_FLAGS; each worker must present exactly 2)
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def main() -> None:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    sys.path.insert(0, str(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+
+    import numpy as np
+
+    from shallowspeed_tpu.distributed import (barrier, hybrid_mesh,
+                                              initialize, local_rows,
+                                              process_zero)
+    from shallowspeed_tpu.models.transformer import TransformerConfig
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.context import ContextParallelEngine
+
+    assert initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()  # 2 local x 2 procs
+    assert len(jax.local_devices()) == 2
+    assert process_zero() == (pid == 0)
+
+    # dp=4 spans BOTH processes: the gradient pmean/psum crosses the
+    # process boundary; place_global stitches each process's local row
+    # block into the globally-sharded batch.
+    mesh = hybrid_mesh(("dp", "sp"), (4, 1))
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=1,
+                            max_seq=16)
+    eng = ContextParallelEngine(cfg, SGD(0.1), mesh, seed=0)
+
+    for step in range(3):
+        rng = np.random.default_rng([7, step])  # same batch on every proc
+        tok = rng.integers(0, cfg.vocab, (8, 16)).astype(np.int32)
+        tgt = np.roll(tok, -1, axis=1).astype(np.int32)
+        loss = eng.train_batch(local_rows(tok), local_rows(tgt))
+        print(f"LOSS {pid} {step} {loss!r}", flush=True)
+
+    # post-training replica sync check across the process boundary (the
+    # reference's assert_sync, `utils.py:27-31`); sha1, not hash() —
+    # Python's hash is salted per process
+    import hashlib
+
+    w = np.asarray(jax.device_get(eng.params["tok_emb"]))
+    print(f"HASH {pid} {hashlib.sha1(w.tobytes()).hexdigest()}", flush=True)
+    barrier("done")
+    print(f"DONE {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,9 @@ Notes:
 """
 
 import os
+from pathlib import Path
+
+import pytest
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -30,3 +33,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+# Test tiers: nodeids listed in slow_tests.txt (measured compile-heavy
+# cross-engine matrices) get the `slow` marker; pyproject's addopts
+# excludes them by default. Full run: pytest -m "slow or not slow".
+_SLOW = set((Path(__file__).parent / "slow_tests.txt").read_text().split())
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.nodeid in _SLOW:
+            item.add_marker(pytest.mark.slow)

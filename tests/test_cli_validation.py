@@ -64,8 +64,7 @@ def test_resume_requires_save_dir():
     expect_exit(["--resume"], "require --save-dir")
 
 
-def test_attn_window_guards():
-    expect_exit(["--attn-window", "64", "--sp", "2"],
-                "--attn-window composes with")
-    expect_exit(["--attn-window", "64", "--attn", "flash"],
-                "--attn-window composes with")
+# --attn-window now composes with every substrate (flash skips
+# out-of-window tiles, ring/ulysses mask by global position) — the old
+# rejection tests are gone; composition is covered by
+# tests/test_attention.py / test_flash_attention.py window parity.

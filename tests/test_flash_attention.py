@@ -25,7 +25,8 @@ def qkv(b=2, t=128, h=2, d=32, seed=0):
 def test_forward_matches_reference(causal, t, bq, bk):
     q, k, v = qkv(t=t)
     want = np.asarray(attention(q, k, v, causal=causal))
-    got = np.asarray(flash_attention(q, k, v, causal, bq, bk, True))
+    got = np.asarray(flash_attention(q, k, v, causal, block_q=bq,
+                                     block_k=bk, interpret=True))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
@@ -37,7 +38,8 @@ def test_gradients_match_reference(causal):
         return (attention(q, k, v, causal=causal) ** 2).sum()
 
     def flash_loss(q, k, v):
-        return (flash_attention(q, k, v, causal, 32, 32, True) ** 2).sum()
+        return (flash_attention(q, k, v, causal, block_q=32, block_k=32,
+                                interpret=True) ** 2).sum()
 
     g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
     g_fl = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
@@ -50,7 +52,7 @@ def test_block_autoshrink_odd_sequence():
     """T=40 not divisible by 128: blocks shrink to a divisor automatically."""
     q, k, v = qkv(t=40, d=16)
     want = np.asarray(attention(q, k, v, causal=True))
-    got = np.asarray(flash_attention(q, k, v, True, 128, 128, True))
+    got = np.asarray(flash_attention(q, k, v, True, interpret=True))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
@@ -78,6 +80,113 @@ def test_transformer_with_flash_attention():
                     jax.tree_util.tree_leaves(g_flash)):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=5e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [1, 16, 48, 64, 1000])
+@pytest.mark.parametrize("causal", [True, False])
+def test_window_matches_reference(window, causal):
+    """Sliding-window flash == masked `ops.attention` (the semantics
+    oracle, `attention(..., window=w)`), fwd and VJP, including tile
+    boundary cases (window smaller / larger than a block; window 1 =
+    self-only; window >= T = no-op)."""
+    q, k, v = qkv(t=64, d=16)
+    want = np.asarray(attention(q, k, v, causal=causal, window=window))
+    got = np.asarray(flash_attention(q, k, v, causal, window,
+                                     block_q=16, block_k=16,
+                                     interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(lambda *a: (
+        attention(*a, causal=causal, window=window) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(lambda *a: (
+        flash_attention(*a, causal, window, block_q=16, block_k=16,
+                        interpret=True) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-5, err_msg=f"d{name}")
+
+
+def test_window_streaming_matches_resident(monkeypatch):
+    """The streaming (3-D grid) kernels honor windows identically."""
+    import shallowspeed_tpu.ops.flash_attention as fa
+
+    q, k, v = qkv(t=128, d=16)
+    want = np.asarray(attention(q, k, v, causal=True, window=40))
+    monkeypatch.setattr(fa, "_RESIDENT_BYTES", 0)
+    got = np.asarray(fa.flash_attention(q, k, v, True, 40, block_q=32,
+                                        block_k=32, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    g_ref = jax.grad(lambda *a: (
+        attention(*a, causal=True, window=40) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(lambda *a: (
+        fa.flash_attention(*a, True, 40, block_q=32, block_k=32,
+                           interpret=True) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-5, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("kvh,window", [(1, 0), (2, 0), (2, 24), (4, 0)])
+def test_gqa_native_matches_repeated(kvh, window):
+    """GQA q-row group folding == attention over jnp.repeat'ed K/V: the
+    kernel must produce identical outputs AND identical (k, v) grads —
+    the repeated formulation's dk/dv sum over group members."""
+    h = 4
+    rng = np.random.default_rng(3)
+    q = rng.normal(size=(2, 64, h, 16)).astype(np.float32)
+    k = rng.normal(size=(2, 64, kvh, 16)).astype(np.float32)
+    v = rng.normal(size=(2, 64, kvh, 16)).astype(np.float32)
+    g = h // kvh
+    k_rep = np.repeat(k, g, axis=2)
+    v_rep = np.repeat(v, g, axis=2)
+
+    want = np.asarray(attention(q, k_rep, v_rep, causal=True,
+                                window=window))
+    got = np.asarray(flash_attention(q, k, v, True, window, block_q=16,
+                                     block_k=16, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    g_ref = jax.grad(lambda q, k, v: (attention(
+        q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+        causal=True, window=window) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, True, window, block_q=16, block_k=16,
+        interpret=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=5e-5, err_msg=f"d{name}")
+
+
+def test_gqa_streaming_matches_resident(monkeypatch):
+    """GQA group folding on the streaming (3-D grid) kernels too."""
+    import shallowspeed_tpu.ops.flash_attention as fa
+
+    rng = np.random.default_rng(5)
+    q = rng.normal(size=(1, 128, 4, 16)).astype(np.float32)
+    k = rng.normal(size=(1, 128, 2, 16)).astype(np.float32)
+    v = rng.normal(size=(1, 128, 2, 16)).astype(np.float32)
+    want = np.asarray(fa.flash_attention(q, k, v, causal=True,
+                                         interpret=True))
+    monkeypatch.setattr(fa, "_RESIDENT_BYTES", 0)
+    got = np.asarray(fa.flash_attention(q, k, v, causal=True,
+                                        interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def loss(fn):
+        return lambda *a: (fn(*a, True) ** 2).sum()
+
+    g_stream = jax.grad(loss(fa.flash_attention),
+                        argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.undo()
+    g_res = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_stream, g_res):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_streaming_fwd_matches_resident(monkeypatch):

@@ -150,3 +150,31 @@ def test_top_p_generate_deterministic():
     b = generate(params, prompt, CFG, max_new=8, temperature=1.0,
                  top_p=0.9, seed=5)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_decode_scan_traces_once(monkeypatch):
+    """The decode loop is a lax.scan over a once-traced body — NOT an
+    unrolled per-token retrace. Guard: the number of `decode_step` traces
+    during a 16-token generation stays far below the token count, and a
+    SECOND generation with the same shapes adds zero traces (the jit
+    cache holds)."""
+    import shallowspeed_tpu.models.generate as G
+
+    calls = {"n": 0}
+    real = G.decode_step
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(G, "decode_step", counting)
+    params = jax.device_put(T.init(CFG, seed=0))
+    out = G.generate(params, toks(t=8), CFG, 16, temperature=0.0)
+    jax.block_until_ready(out)
+    first = calls["n"]
+    assert 1 <= first <= 4, (
+        f"decode_step traced {first} times for 16 tokens — the scan "
+        f"body is being unrolled or retraced per token")
+    out2 = G.generate(params, toks(seed=1, t=8), CFG, 16, temperature=0.0)
+    jax.block_until_ready(out2)
+    assert calls["n"] == first, "same-shape generation retraced the scan"

@@ -123,8 +123,9 @@ def test_bf16_moe_router_stays_f32():
            "bo": np.zeros((e, d), np.float32)}
     x32 = jnp.asarray(rng.normal(0, 1, (2, 16, d)), jnp.float32)
     p16 = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.bfloat16), p32)
-    y32, aux32, _z32 = moe_ffn(p32, x32, 2, 2.0)
-    y16, aux16, _z16 = moe_ffn(p16, x32.astype(jnp.bfloat16), 2, 2.0)
+    y32, aux32, _z32, _s32 = moe_ffn(p32, x32, 2, 2.0)
+    y16, aux16, _z16, _s16 = moe_ffn(p16, x32.astype(jnp.bfloat16),
+                                     2, 2.0)
     assert float(aux16) == pytest.approx(float(aux32), rel=0.05)
     np.testing.assert_allclose(np.asarray(y16, np.float32), np.asarray(y32),
                                atol=0.06, rtol=0.1)

@@ -43,8 +43,8 @@ def test_routing_conservation_with_ample_capacity():
     weights sum to 1 (top-k gates are renormalized)."""
     rng = np.random.default_rng(0)
     logits = jnp.asarray(rng.normal(size=(2, 16, 4)), jnp.float32)
-    combine, dispatch, aux = topk_capacity_routing(logits, capacity=16,
-                                                   top_k=2)
+    combine, dispatch, aux, _st = topk_capacity_routing(
+        logits, capacity=16, top_k=2)
     np.testing.assert_allclose(np.asarray(combine.sum(axis=(2, 3))),
                                np.ones((2, 16)), rtol=1e-5)
     assert bool((np.asarray(dispatch) == (np.asarray(combine) > 0)).all())
@@ -57,8 +57,8 @@ def test_routing_respects_capacity():
     rng = np.random.default_rng(1)
     g, s, e, cap = 2, 32, 4, 3
     logits = jnp.asarray(rng.normal(size=(g, s, e)), jnp.float32)
-    combine, dispatch, _ = topk_capacity_routing(logits, capacity=cap,
-                                                 top_k=2)
+    combine, dispatch, _, _st = topk_capacity_routing(
+        logits, capacity=cap, top_k=2)
     # one token per (expert, slot) position
     per_slot = np.asarray(dispatch).sum(axis=1)          # (g, e, cap)
     assert per_slot.max() <= 1
@@ -73,7 +73,8 @@ def test_routing_respects_capacity():
 def test_top1_routing_sends_full_weight():
     rng = np.random.default_rng(2)
     logits = jnp.asarray(rng.normal(size=(1, 8, 4)), jnp.float32)
-    combine, _, _ = topk_capacity_routing(logits, capacity=8, top_k=1)
+    combine, _, _, _st = topk_capacity_routing(logits, capacity=8,
+                                          top_k=1)
     np.testing.assert_allclose(np.asarray(combine.sum(axis=(2, 3))),
                                np.ones((1, 8)), rtol=1e-6)
 
@@ -98,7 +99,7 @@ def test_single_expert_equals_dense_ffn():
     p = {"gate": np.zeros((d, 1), np.float32),
          "wi": wi, "bi": np.zeros((1, ff), np.float32),
          "wo": wo, "bo": np.zeros((1, d), np.float32)}
-    y, aux, _z = moe_ffn(p, x, top_k=1, capacity_factor=float(s))
+    y, aux, _z, _st = moe_ffn(p, x, top_k=1, capacity_factor=float(s))
     dense = jax.nn.gelu(x @ wi[0]) @ wo[0]
     np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
                                rtol=1e-4, atol=1e-5)
@@ -174,6 +175,58 @@ def test_moe_with_sequence_sharding():
         tgt = np.roll(tok, -1, axis=1).astype(np.int32)
         assert eng.train_batch(tok, tgt) == pytest.approx(
             ref.train_batch(tok, tgt), rel=3e-4), step
+
+
+# ---------------------------------------------------------- routing stats
+
+
+def test_routing_stats_no_drop_with_ample_capacity():
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.normal(size=(2, 16, 4)), jnp.float32)
+    _, _, _, st = topk_capacity_routing(logits, capacity=16, top_k=2)
+    assert float(st["drop_fraction"]) == pytest.approx(0.0, abs=1e-6)
+    np.testing.assert_allclose(float(st["load"].sum()), 1.0, rtol=1e-5)
+
+
+def test_routing_stats_count_capacity_drops():
+    """Uniform logits to ONE expert with capacity 1: per group, s tokens
+    route top-1 to the same expert, 1 survives — the drop fraction and
+    load vector must say exactly that."""
+    g, s, e = 2, 8, 4
+    logits = jnp.zeros((g, s, e), jnp.float32).at[..., 0].set(10.0)
+    _, _, _, st = topk_capacity_routing(logits, capacity=1, top_k=1)
+    assert float(st["drop_fraction"]) == pytest.approx((s - 1) / s)
+    np.testing.assert_allclose(np.asarray(st["load"]),
+                               [1.0, 0.0, 0.0, 0.0], atol=1e-6)
+
+
+def test_engine_router_stats_surface():
+    """Both MoE-capable engines expose the accounting; a dense config
+    returns None (no silent pretend-stats)."""
+    from shallowspeed_tpu.parallel.context import ContextParallelEngine
+
+    tok, tgt = toy_batch()
+    eng = ExpertParallelEngine(MOE_CFG, SGD(0.1), ep_mesh(2, 2), seed=0)
+    rs = eng.router_stats(tok)
+    assert set(rs) == {"expert_load", "drop_fraction"}
+    assert len(rs["expert_load"]) == MOE_CFG.n_experts
+    assert 0.0 <= rs["drop_fraction"] <= 1.0
+    assert abs(sum(rs["expert_load"]) - 1.0) < 1e-3
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("dp", "sp"))
+    ctx = ContextParallelEngine(MOE_CFG, SGD(0.1), mesh, seed=0)
+    rs2 = ctx.router_stats(tok)
+    assert set(rs2) == {"expert_load", "drop_fraction"}
+    # same params, same batch -> the two engines must agree on routing
+    np.testing.assert_allclose(rs2["expert_load"], rs["expert_load"],
+                               atol=2e-3)
+    assert rs2["drop_fraction"] == pytest.approx(rs["drop_fraction"],
+                                                 abs=2e-3)
+
+    dense_cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                    n_layers=1, max_seq=64)
+    dense = ContextParallelEngine(dense_cfg, SGD(0.1), mesh, seed=0)
+    assert dense.router_stats(tok) is None
 
 
 # ------------------------------------------------------------ router z-loss

@@ -126,13 +126,28 @@ def test_windowed_trains():
     assert losses[-1] < losses[0], losses[::5]
 
 
-def test_window_rejected_on_fused_substrates():
+def test_window_composes_with_fused_substrates():
+    """Windows now compose with EVERY substrate (the round-1 verdict's
+    gap): flash (tile-skipping kernel), sequence-sharded ring, and
+    ulysses-flash must all train the same windowed model — per-step
+    losses match the masked-XLA reference."""
     cfg = replace(CFG, attn_window=8)
-    with pytest.raises(AssertionError, match="attn_window"):
-        ContextParallelEngine(cfg, SGD(0.1), mesh2(1), seed=0,
-                              attn="flash")
-    with pytest.raises(AssertionError, match="attn_window"):
-        PipelineLMEngine(
+    ref = ContextParallelEngine(cfg, SGD(0.1), mesh2(1), seed=0)
+    mesh_sp = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "sp"))
+    engines = {
+        "flash": ContextParallelEngine(cfg, SGD(0.1), mesh2(1), seed=0,
+                                       attn="flash"),
+        "ring-sp2": ContextParallelEngine(cfg, SGD(0.1), mesh_sp, seed=0),
+        "ulysses-flash-sp2": ContextParallelEngine(
+            cfg, SGD(0.1), mesh_sp, seed=0, attn="ulysses-flash"),
+        "pipeline-flash": PipelineLMEngine(
             cfg, SGD(0.1),
             Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "pp")),
-            seed=0, attn="flash")
+            n_mubatches=2, seed=0, attn="flash"),
+    }
+    for s in range(3):
+        tok, tgt = batch(s, b=8)
+        want = ref.train_batch(tok, tgt)
+        for name, eng in engines.items():
+            assert eng.train_batch(tok, tgt) == pytest.approx(
+                want, rel=3e-4), (name, s)

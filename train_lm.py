@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import sys
 import time
 from pathlib import Path
 
@@ -313,11 +314,9 @@ def train(args) -> float:
                          "subsumes --zero1/--zero2; MoE uses --ep)")
     if args.zero1 and args.zero2:
         raise SystemExit("--zero2 subsumes --zero1; pick one")
-    if args.attn_window > 0 and (args.attn != "ring" or args.sp > 1):
-        raise SystemExit("--attn-window composes with full XLA attention "
-                         "(the default --attn ring at --sp 1, including "
-                         "--tp/--fsdp/--pp); the flash/ring/ulysses "
-                         "substrates do not window")
+    # --attn-window composes with every substrate: the XLA/ring/ulysses
+    # paths mask (ops/attention.py) and the flash kernel skips
+    # out-of-window tiles (ops/flash_attention.py) — no guard needed.
     if not 0.0 <= args.ema_decay < 1.0:
         raise SystemExit(f"--ema-decay must be in [0, 1), got "
                          f"{args.ema_decay} (1.0 would freeze the average "
@@ -601,11 +600,38 @@ def train(args) -> float:
                     toks_s = (args.batch_size * args.seq_len
                               * (step - start_step + 1)
                               / (time.time() - t0 - val_time))
+                    # achieved TFLOP/s + fraction-of-peak (exact matmul
+                    # count per token; None off-TPU where no peak is
+                    # known). toks_s is the GLOBAL rate — divide by the
+                    # engine's mesh size, not one chip's peak.
+                    from shallowspeed_tpu.flops import mfu as _mfu
+
+                    n_chips = getattr(getattr(engine, "mesh", None),
+                                      "devices", np.zeros(1)).size
+                    perf = _mfu(toks_s, cfg, args.seq_len,
+                                dtype="bf16" if args.bf16 else "f32",
+                                n_chips=n_chips)
+                    mfu_txt = ("" if perf["mfu"] is None else
+                               f"  {perf['tflops']:.1f} TF/s "
+                               f"({perf['mfu'] * 100:.1f}% MFU)")
                     rprint(f"step {step:5d}  loss {loss:.4f}  "
-                           f"tok/s {toks_s:,.0f}")
+                           f"tok/s {toks_s:,.0f}{mfu_txt}")
                     metrics.log(event="step", step=step,
                                 loss=round(loss, 6),
-                                tokens_per_sec=round(toks_s, 1))
+                                tokens_per_sec=round(toks_s, 1),
+                                tflops=round(perf["tflops"], 2),
+                                mfu=(None if perf["mfu"] is None
+                                     else round(perf["mfu"], 4)))
+                    if args.experts and hasattr(engine, "router_stats"):
+                        # routing observability: the capacity drop is
+                        # silent in the loss (ops/moe.py), so surface it
+                        rs = engine.router_stats(tok)
+                        if rs is not None:
+                            rprint(f"             moe drop "
+                                   f"{rs['drop_fraction']:.1%}  load "
+                                   f"{rs['expert_load']}")
+                            metrics.log(event="moe_router", step=step,
+                                        **rs)
                 if args.val_every and ((step + 1) % args.val_every == 0
                                        or step == args.steps - 1):
                     # drain queued TRAIN work first, so its wall time isn't
@@ -629,7 +655,17 @@ def train(args) -> float:
         if hasattr(placed, "close"):
             placed.close()
         if saver is not None:
-            saver.close()  # drain queued writes; surface any IO error
+            if sys.exc_info()[0] is None:
+                saver.close()  # drain queued writes; surface any IO error
+            else:
+                # an exception is already propagating (e.g. the divergence
+                # SystemExit with its forensic-snapshot path) — don't let a
+                # checkpoint-write error from close() replace it
+                try:
+                    saver.close()
+                except Exception as ckpt_err:
+                    print(f"[warn] async checkpoint save failed during "
+                          f"teardown: {ckpt_err!r}", file=sys.stderr)
 
     if args.generate > 0:
         with ema_weights():
