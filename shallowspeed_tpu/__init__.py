@@ -55,15 +55,20 @@ _EXPORTS = {
     "ByteBPE": "shallowspeed_tpu.data.tokenizer",
     "train_bpe": "shallowspeed_tpu.data.tokenizer",
     "simulate_schedule": "shallowspeed_tpu.parallel.verify",
+    # failure detection / elastic recovery
+    "Supervisor": "shallowspeed_tpu.elastic",
+    "RestartPolicy": "shallowspeed_tpu.elastic",
     # subsystem modules
     "checkpoint": "shallowspeed_tpu.checkpoint",
     "distributed": "shallowspeed_tpu.distributed",
+    "elastic": "shallowspeed_tpu.elastic",
     "metrics": "shallowspeed_tpu.metrics",
     "optim": "shallowspeed_tpu.optim",
     "utils": "shallowspeed_tpu.utils",
 }
 
-_MODULE_EXPORTS = {"checkpoint", "distributed", "metrics", "optim", "utils"}
+_MODULE_EXPORTS = {"checkpoint", "distributed", "elastic", "metrics",
+                   "optim", "utils"}
 
 __all__ = sorted(_EXPORTS) + ["functional"]
 

@@ -1,0 +1,201 @@
+"""Failure detection and elastic recovery — the one subsystem the
+reference lacks outright (SURVEY §5: "any rank failure kills the mpirun
+job; no retry/respawn/timeout logic anywhere").
+
+TPU-native elasticity is CHECKPOINT-based, not rank-respawn-based: a
+single-controller JAX job either runs or it doesn't (there is no
+per-rank membership to patch up, unlike MPI), so recovery means
+"restart the process and resume from the last good checkpoint". The
+pieces:
+
+- **In-loop failure detection** (already in the drivers): divergence
+  gets a labeled SystemExit + forensic snapshot (train_lm.py), the
+  post-run replica sync-assert catches silent corruption (utils.py),
+  and `--heartbeat-file` gives an external liveness signal.
+- **`Supervisor`** (this module): runs the training command as a child
+  process and restarts it on failure with exponential backoff, up to a
+  restart budget. With `--auto-resume` in the child's argv, every
+  restart continues from `checkpoint.latest(save_dir)` — the crash
+  costs at most `--save-every` steps of work. A restart budget that
+  REFILLS after a healthy run-time window (like torchelastic's
+  max_restarts semantics) distinguishes a flaky infrastructure blip
+  from a deterministic crash loop.
+- **Hang detection**: if the child's heartbeat file (touched at every
+  log point) goes stale for longer than `hang_timeout`, the child is
+  killed and the restart policy takes over — covering wedged device
+  queues / deadlocked input pipelines that would never exit on their
+  own.
+
+CLI:
+
+    python -m shallowspeed_tpu.elastic --max-restarts 3 \
+        --hang-timeout 600 -- \
+        python train_lm.py --save-dir ck --auto-resume ...
+
+The `--` separates supervisor flags from the training command. The
+supervisor injects `--heartbeat-file` automatically when hang detection
+is on and the command does not already carry one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RestartPolicy:
+    """Budgeted restarts with exponential backoff.
+
+    `max_restarts` failures are tolerated; each backoff doubles from
+    `backoff` up to `backoff_max`. A child that stayed up longer than
+    `healthy_after` seconds refills the budget and resets the backoff —
+    a long-running job that hits one bad preemption a day should never
+    exhaust its budget."""
+
+    max_restarts: int = 3
+    backoff: float = 5.0
+    backoff_max: float = 300.0
+    healthy_after: float = 600.0
+
+    _used: int = field(default=0, init=False)
+    _next_backoff: float = field(default=0.0, init=False)
+
+    def __post_init__(self):
+        self._next_backoff = self.backoff
+
+    def record_run(self, run_seconds: float) -> None:
+        if run_seconds >= self.healthy_after:
+            self._used = 0
+            self._next_backoff = self.backoff
+
+    def next_restart(self) -> float | None:
+        """Delay before the next restart, or None when the budget is
+        exhausted."""
+        if self._used >= self.max_restarts:
+            return None
+        self._used += 1
+        delay = self._next_backoff
+        self._next_backoff = min(self._next_backoff * 2, self.backoff_max)
+        return delay
+
+
+class Supervisor:
+    """Run `argv` as a child process; restart on failure per `policy`;
+    kill-and-restart on heartbeat staleness when `hang_timeout` is set."""
+
+    def __init__(self, argv: list[str], policy: RestartPolicy | None = None,
+                 hang_timeout: float | None = None,
+                 heartbeat_file: str | None = None,
+                 poll_interval: float = 1.0,
+                 log=print):
+        self.argv = list(argv)
+        self.policy = policy or RestartPolicy()
+        self.hang_timeout = hang_timeout
+        self.poll_interval = poll_interval
+        self.log = log
+        if hang_timeout is not None and heartbeat_file is None:
+            if "--heartbeat-file" in self.argv:
+                heartbeat_file = self.argv[
+                    self.argv.index("--heartbeat-file") + 1]
+            else:
+                fd, heartbeat_file = tempfile.mkstemp(prefix="hb_")
+                os.close(fd)
+                self.argv += ["--heartbeat-file", heartbeat_file]
+        self.heartbeat_file = heartbeat_file
+
+    # ------------------------------------------------------------ child
+
+    def _run_once(self) -> tuple[int, float]:
+        """One child run. Returns (exit code, run seconds); a hang kill
+        reports exit code -9."""
+        t0 = time.monotonic()
+        if self.heartbeat_file:
+            # a fresh child gets a fresh liveness clock
+            try:
+                os.utime(self.heartbeat_file, None)
+            except OSError:
+                open(self.heartbeat_file, "w").close()
+        child = subprocess.Popen(self.argv)
+        while True:
+            code = child.poll()
+            if code is not None:
+                return code, time.monotonic() - t0
+            if self.hang_timeout is not None:
+                try:
+                    stale = time.time() - os.path.getmtime(
+                        self.heartbeat_file)
+                except OSError:
+                    stale = 0.0
+                if stale > self.hang_timeout:
+                    self.log(f"[elastic] heartbeat stale {stale:.0f}s > "
+                             f"{self.hang_timeout}s — killing child "
+                             f"{child.pid}")
+                    child.send_signal(signal.SIGKILL)
+                    child.wait()
+                    return -9, time.monotonic() - t0
+            time.sleep(self.poll_interval)
+
+    # ------------------------------------------------------------- loop
+
+    def run(self) -> int:
+        """Supervise until the child exits 0 or the restart budget is
+        exhausted; returns the final exit code."""
+        attempt = 0
+        while True:
+            attempt += 1
+            self.log(f"[elastic] attempt {attempt}: {' '.join(self.argv)}")
+            code, secs = self._run_once()
+            if code == 0:
+                self.log(f"[elastic] child finished cleanly after "
+                         f"{secs:.0f}s")
+                return 0
+            self.policy.record_run(secs)
+            delay = self.policy.next_restart()
+            if delay is None:
+                self.log(f"[elastic] child failed (exit {code}) and the "
+                         f"restart budget is exhausted; giving up")
+                return code if code > 0 else 1
+            self.log(f"[elastic] child failed (exit {code}) after "
+                     f"{secs:.0f}s; restarting in {delay:.1f}s")
+            time.sleep(delay)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m shallowspeed_tpu.elastic",
+        description="Restart-on-failure supervisor with checkpoint-based "
+                    "recovery (pair with --save-dir/--auto-resume)")
+    ap.add_argument("--max-restarts", type=int, default=3)
+    ap.add_argument("--backoff", type=float, default=5.0)
+    ap.add_argument("--backoff-max", type=float, default=300.0)
+    ap.add_argument("--healthy-after", type=float, default=600.0,
+                    help="a run this long refills the restart budget")
+    ap.add_argument("--hang-timeout", type=float, default=None,
+                    help="kill the child if its heartbeat file goes "
+                         "stale this long (seconds)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- training command")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no training command given (separate it with --)")
+    sup = Supervisor(
+        cmd,
+        RestartPolicy(max_restarts=args.max_restarts,
+                      backoff=args.backoff, backoff_max=args.backoff_max,
+                      healthy_after=args.healthy_after),
+        hang_timeout=args.hang_timeout)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

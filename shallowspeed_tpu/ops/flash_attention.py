@@ -459,7 +459,7 @@ def _sds(shape, dtype, like):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True, window: int = 0,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool | None = None):
     """Fused multi-head attention; same contract as `ops.attention`.
 

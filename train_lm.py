@@ -185,6 +185,14 @@ def parse_args(argv=None):
                    help="checkpoint every N steps when --save-dir is set")
     p.add_argument("--save-dir", type=str, default="")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="resume from the latest checkpoint if one exists, "
+                        "start fresh otherwise — the restart-safe mode "
+                        "the elastic supervisor (shallowspeed_tpu."
+                        "elastic) relies on")
+    p.add_argument("--heartbeat-file", type=str, default="",
+                   help="touch this file at every log point; the elastic "
+                        "supervisor watches its mtime for hang detection")
     p.add_argument("--log-file", type=str, default="")
     p.add_argument("--profile-dir", type=str, default="",
                    help="write a jax.profiler trace of the training loop")
@@ -289,8 +297,10 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
 
-    if (args.resume or args.sample_only) and not args.save_dir:
-        raise SystemExit("--resume/--sample-only require --save-dir")
+    if ((args.resume or args.sample_only or args.auto_resume)
+            and not args.save_dir):
+        raise SystemExit(
+            "--resume/--auto-resume/--sample-only require --save-dir")
     if (args.prompt or args.sample_only) and not args.generate:
         args.generate = 128  # --prompt/--sample-only imply sampling
     prompt_len = len(args.prompt.encode()) if args.prompt else 16
@@ -441,6 +451,10 @@ def train(args) -> float:
 
     start_step = 0
     restored_ckpt = None
+    if args.auto_resume and not args.resume:
+        # elastic restarts: resume iff a checkpoint exists, else fresh
+        if checkpoint.latest(args.save_dir) is not None:
+            args.resume = True
     if args.resume or args.sample_only:  # save-dir presence checked early
         ck = checkpoint.latest(args.save_dir)
         if ck is None:
@@ -579,6 +593,10 @@ def train(args) -> float:
                 if ema is not None:
                     ema = ema_update(ema, engine.params, args.ema_decay)
                 if sync_every(step, args.log_every, args.steps):
+                    if args.heartbeat_file:
+                        # liveness signal for the elastic supervisor: a
+                        # stale mtime means the step loop is hung
+                        Path(args.heartbeat_file).touch()
                     loss = float(loss_dev)
                     if not np.isfinite(loss):
                         # failure detection: divergence gets a labeled exit
