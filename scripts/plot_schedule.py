@@ -1,0 +1,123 @@
+"""Pipeline-schedule pebble graphs from the executable simulator.
+
+The reference README illustrates its schedules with a static image
+(`/root/reference/README.md:41`); here the picture is GENERATED from the
+same simulation that proves the schedule correct
+(`parallel/verify.py::simulate` — FIFO channel semantics, unit-cost
+compute rounds), so the diagram can never drift from the code. Prints an
+ASCII pebble graph per schedule (stages x rounds, F<mu>/B<mu> cells) with
+makespan / bubble-fraction / peak-stash numbers, and optionally writes a
+standalone SVG.
+
+Usage:
+    python scripts/plot_schedule.py [--pp 4] [--n-mu 8] [--svg out.svg]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from shallowspeed_tpu.parallel.schedules import (  # noqa: E402
+    GPipeSchedule, InferenceSchedule, NaiveParallelSchedule,
+    PipeDreamSchedule)
+from shallowspeed_tpu.parallel.verify import simulate  # noqa: E402
+
+SCHEDULES = [
+    ("naive", NaiveParallelSchedule, True),
+    ("gpipe", GPipeSchedule, True),
+    ("1f1b (PipeDream-Flush)", PipeDreamSchedule, True),
+    ("inference", InferenceSchedule, False),
+]
+
+
+def cells(report, pp):
+    """(stage, round) -> label grid from the simulator's round maps."""
+    grid = {}
+    for (s, mu), r in report.fwd_rounds.items():
+        grid[(s, r)] = f"F{mu}"
+    for (s, mu), r in report.bwd_rounds.items():
+        grid[(s, r)] = f"B{mu}"
+    return grid
+
+
+def ascii_graph(name, report, pp, n_mu, training) -> str:
+    grid = cells(report, pp)
+    span = report.makespan
+    work = (2 if training else 1) * n_mu
+    bubble = 1.0 - work / span if span else 0.0
+    out = [f"{name}  pp={pp}  n_mu={n_mu}  makespan={span} rounds  "
+           f"bubble={bubble:.0%}  peak stash={report.peak_stash}"]
+    for s in range(pp):
+        row = "".join(f"{grid.get((s, r), '.'):>4}" for r in range(span))
+        out.append(f"  stage {s} |{row}")
+    return "\n".join(out)
+
+
+def svg_graph(reports, pp, n_mu, path):
+    """One SVG with all schedules stacked; fwd = blue family, bwd =
+    orange family, shaded by microbatch."""
+    cw, ch, pad, gap = 26, 18, 6, 34
+    span_max = max(r.makespan for _, r, _ in reports)
+    width = pad * 2 + 70 + span_max * cw
+    height = pad + sum(gap + pp * ch + pad for _ in reports)
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+             f'height="{height}" font-family="monospace" font-size="11">']
+    y = pad
+    for name, rep, _training in reports:
+        parts.append(f'<text x="{pad}" y="{y + 12}">{name}  '
+                     f'(makespan {rep.makespan}, peak stash '
+                     f'{rep.peak_stash})</text>')
+        y += gap - 14
+        grid = cells(rep, pp)
+        for s in range(pp):
+            for r in range(rep.makespan):
+                lab = grid.get((s, r))
+                x = pad + 70 + r * cw
+                yy = y + s * ch
+                if lab:
+                    mu = int(lab[1:])
+                    shade = 35 + int(45 * (mu / max(1, n_mu - 1)))
+                    hue = 210 if lab[0] == "F" else 25
+                    fill = f"hsl({hue},70%,{shade}%)"
+                    parts.append(
+                        f'<rect x="{x}" y="{yy}" width="{cw - 2}" '
+                        f'height="{ch - 2}" fill="{fill}"/>')
+                    parts.append(
+                        f'<text x="{x + 3}" y="{yy + 13}" '
+                        f'fill="white">{lab}</text>')
+                else:
+                    parts.append(
+                        f'<rect x="{x}" y="{yy}" width="{cw - 2}" '
+                        f'height="{ch - 2}" fill="#eee"/>')
+            parts.append(f'<text x="{pad}" y="{y + s * ch + 13}">'
+                         f'stage {s}</text>')
+        y += pp * ch + pad
+    parts.append("</svg>")
+    Path(path).write_text("\n".join(parts))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pp", type=int, default=4)
+    ap.add_argument("--n-mu", type=int, default=8)
+    ap.add_argument("--svg", type=str, default="",
+                    help="also write a stacked SVG to this path")
+    args = ap.parse_args()
+
+    reports = []
+    for name, cls, training in SCHEDULES:
+        rep = simulate(cls, args.n_mu, args.pp, training=training)
+        reports.append((name, rep, training))
+        print(ascii_graph(name, rep, args.pp, args.n_mu, training))
+        print()
+    if args.svg:
+        svg_graph(reports, args.pp, args.n_mu, args.svg)
+        print(f"wrote {args.svg}")
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,32 @@ def test_hybrid_mesh_rejects_oversubscription():
         D.hybrid_mesh(("dp", "tp"), (8, 2))
 
 
+class _FakeDev:
+    """Minimal stand-in carrying `slice_index` — enough to drive
+    hybrid_mesh's multi-slice validation (the real
+    create_hybrid_device_mesh needs genuine devices and real slices)."""
+
+    def __init__(self, i, slice_index):
+        self.id = i
+        self.slice_index = slice_index
+
+
+def test_hybrid_mesh_dcn_axis_must_match_slice_count():
+    devs = [_FakeDev(i, slice_index=i // 4) for i in range(8)]  # 2 slices
+    with pytest.raises(ValueError, match="fleet has 2 slices"):
+        # leftmost (DCN) axis sized 4 over a 2-slice fleet
+        D.hybrid_mesh(("dp", "tp"), (4, 2), devices=devs)
+
+
+def test_hybrid_mesh_rejects_short_slices():
+    # 8 devices total, but lopsided: slice 1 has only 3 of the 4 the
+    # ICI axes need per slice
+    devs = ([_FakeDev(i, 0) for i in range(5)]
+            + [_FakeDev(5 + i, 1) for i in range(3)])
+    with pytest.raises(ValueError, match="slices \\[1\\] have only"):
+        D.hybrid_mesh(("dp", "tp"), (2, 4), devices=devs)
+
+
 def test_place_global_single_process_is_device_put():
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
     sh = NamedSharding(mesh, P("dp", "sp"))

