@@ -19,10 +19,11 @@ repo is the PyTorch baseline's `torch.save`,
   fetch); layout-transforming engines (the pipeline) additionally write
   `opt_canon.npz` via `Optimizer.map_state_trees` + their params-layout
   transform. Cross-engine resume then restores moments exactly (a dp=4
-  Adam checkpoint resumes into dp=2 x pp=4); only pairs with genuinely
-  non-portable state (Adafactor's factored vectors across factoring-
-  incompatible placements, the per-stage MLP instruction-VM) fall back
-  to re-initialization with a warning.
+  Adam checkpoint resumes into dp=2 x pp=4, and the MLP family's
+  fused / padded-SPMD / per-stage-VM engines interchange moments the
+  same way); only genuinely non-portable state (Adafactor's factored
+  vectors across factoring-incompatible placements) falls back to
+  re-initialization with a warning.
 - On-disk format: one `.npz` per pytree — numbered array leaves plus a JSON
   structure descriptor. No pickle anywhere (a checkpoint from an untrusted
   source cannot execute code at load time), no orbax dependency, loadable
@@ -143,14 +144,18 @@ def _canon_opt_export(engine, host_opt_state=None):
     opt = getattr(engine, "optimizer", None)
     if opt is None or getattr(engine, "canonical_opt_identity", False):
         return None, None
+    meta = {"optimizer": type(opt).__name__}
+    custom = getattr(engine, "canon_opt_export", None)
+    if custom is not None:  # engines whose state is not one pytree
+        canon = custom()    # (the per-stage instruction VM)
+        return (None, None) if canon is None else (canon, meta)
     export = getattr(engine, "canon_export_tree", None)
     if export is None:
         return None, None
     if host_opt_state is None:
         host_opt_state = jax.device_get(engine.opt_state)
     try:
-        return (opt.map_state_trees(host_opt_state, export),
-                {"optimizer": type(opt).__name__})
+        return opt.map_state_trees(host_opt_state, export), meta
     except ValueError:
         return None, None
 
@@ -173,6 +178,9 @@ def _canon_opt_import(engine, canon):
     shape (host-side). None when this engine can't import."""
     if getattr(engine, "canonical_opt_identity", False):
         return canon
+    custom = getattr(engine, "canon_opt_import", None)
+    if custom is not None:
+        return custom(canon)
     imp = getattr(engine, "canon_import_tree", None)
     if imp is None:
         return None

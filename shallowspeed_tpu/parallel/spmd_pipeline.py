@@ -407,8 +407,10 @@ class SPMDPipelineEngine:
         return [layer for stage_p in self.unstacked_params
                 for layer in stage_p]
 
-    def set_canonical_params(self, layers):
-        """Re-pad the canonical flat layer list into the stage stack."""
+    def _stack_layers(self, layers) -> dict:
+        """Re-pad a canonical flat layer list into the stage-stacked
+        {'W','b'} layout (host-side) — shared by params restore and the
+        canonical optimizer-moment import."""
         st = self.stack
         W = np.zeros((st.pp, st.L, st.wmax, st.wmax), np.float32)
         b = np.zeros((st.pp, st.L, 1, st.wmax), np.float32)
@@ -420,7 +422,24 @@ class SPMDPipelineEngine:
                 b[s, l] = _pad_to(np.asarray(layer["b"]), (1, st.wmax))
                 i += 1
         assert i == len(layers), (i, len(layers))
-        self.params = jax.device_put({"W": W, "b": b}, self.p_shard)
+        return {"W": W, "b": b}
+
+    def set_canonical_params(self, layers):
+        self.params = jax.device_put(self._stack_layers(layers),
+                                     self.p_shard)
+
+    def canon_export_tree(self, tree):
+        """Params-shaped tree (e.g. Adam moments, stacked+padded) ->
+        canonical flat layer list; the padding is zeros-in, zeros-out, so
+        unpadded moments round-trip exactly."""
+        return [layer
+                for stage in self.stack.unstack_params(jax.device_get(tree))
+                for layer in stage]
+
+    def canon_import_tree(self, tree):
+        """Inverse of `canon_export_tree` (host-side; `set_opt_state`
+        applies the sharding specs)."""
+        return self._stack_layers(tree)
 
     def set_opt_state(self, state):
         self.opt_state = jax.device_put(

@@ -344,6 +344,53 @@ class PipelineExecutor:
         for rt, st in zip(self.runtimes, states):
             rt.opt_state = jax.device_put(st, rt.rep)
 
+    @property
+    def optimizer(self):
+        return self.runtimes[0].optimizer
+
+    def canon_opt_export(self):
+        """Merge the per-stage optimizer states into the canonical
+        whole-model state (the pp=1 layout): every params-shaped moment
+        tree is a per-stage layer list, so the canonical moment is their
+        concatenation in stage order — the exact transform
+        `get_canonical_params` applies to the params. Stage-invariant
+        scalars (step counters) come from stage 0 (all stages step in
+        lockstep). None when the optimizer's state is not params-shaped."""
+        states = [jax.device_get(rt.opt_state) for rt in self.runtimes]
+        try:
+            per_stage = []
+            for st in states:
+                trees: list = []
+                self.optimizer.map_state_trees(
+                    st, lambda t: (trees.append(t), t)[1])
+                per_stage.append(trees)
+        except ValueError:
+            return None
+        k = len(per_stage[0])
+        if any(len(t) != k for t in per_stage):
+            return None
+        if k == 0:  # stateless / counter-only: any stage's copy
+            return states[0]
+        merged = iter([
+            [layer for stage in per_stage for layer in stage[i]]
+            for i in range(k)])
+        return self.optimizer.map_state_trees(
+            states[0], lambda _t: next(merged))
+
+    def canon_opt_import(self, canon):
+        """Split a canonical whole-model state back into per-stage
+        states (the inverse of `canon_opt_export`)."""
+        try:
+            out, lo = [], 0
+            for rt in self.runtimes:
+                hi = lo + rt.stage.n_linears
+                out.append(self.optimizer.map_state_trees(
+                    canon, lambda tree, lo=lo, hi=hi: list(tree[lo:hi])))
+                lo = hi
+            return out
+        except ValueError:
+            return None
+
 
 def _flatten(steps_gen):
     for step in steps_gen:

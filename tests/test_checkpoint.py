@@ -126,20 +126,39 @@ def test_cross_engine_spmd_to_vm(tmp_path, data_dir):
     canon_equal(spmd, vm)
 
 
-def test_cross_engine_opt_state_warns(tmp_path, data_dir):
+def test_cross_engine_adam_moments_restore_into_spmd(tmp_path, data_dir):
+    """Round 2: the canonical optimizer record crosses the MLP family's
+    engine boundary too — a fused-DP Adam checkpoint restores its
+    moments into the padded stage-stacked SPMD engine EXACTLY (padding
+    is zeros-in, zeros-out), and both engines then train identically."""
     eng = fused_engine(opt=Adam(0.01))
     ds = make_ds(data_dir)
     eng.train_batch(0, ds)
     checkpoint.save(tmp_path, eng, epoch=0)
     spmd = SPMDPipelineEngine(SIZES, Adam(0.01), make_mesh(1, 2), N_MU,
                               GBS // N_MU, GBS)
-    with pytest.warns(UserWarning, match="re-initializing"):
-        checkpoint.restore(spmd, checkpoint.latest(tmp_path))
+    checkpoint.restore(spmd, checkpoint.latest(tmp_path))  # no warning
+    # moments made it across: the restored m tree is nonzero and equals
+    # the source's canonical m layer-for-layer
+    import jax
+
+    src_m = jax.device_get(eng.opt_state["m"])
+    got_m = spmd.canon_export_tree(spmd.opt_state["m"])
+    for a, b in zip(src_m, got_m):
+        np.testing.assert_allclose(b["W"], np.asarray(a["W"]),
+                                   rtol=1e-6, atol=1e-8)
+    eng.train_batch(1, ds)
+    spmd.train_batch(1, ds)
+    # identical moments -> next steps agree up to float reassociation
+    # (fused vs pipelined summation order)
+    canon_equal(eng, spmd, rtol=2e-4, atol=1e-6)
 
 
-def test_same_class_different_topology_reinits_opt_state(tmp_path, data_dir):
-    """Same engine class but different pp: opt state is engine-shaped per
-    topology, so it must be re-initialized (with a warning), not installed."""
+def test_same_class_different_topology_restores_via_canonical(
+        tmp_path, data_dir):
+    """Same VM engine class, different pp: per-stage states re-split
+    through the canonical record (concat/split by stage layer counts) —
+    no re-init, and training continues in lockstep with the source."""
     stages4 = [MLPStage(SIZES, s, 4, batch_size=GBS) for s in range(4)]
     vm4 = PipelineExecutor(make_mesh(1, 4), stages4, Adam(0.01))
     ds = make_ds(data_dir)
@@ -148,10 +167,12 @@ def test_same_class_different_topology_reinits_opt_state(tmp_path, data_dir):
 
     stages2 = [MLPStage(SIZES, s, 2, batch_size=GBS) for s in range(2)]
     vm2 = PipelineExecutor(make_mesh(1, 2), stages2, Adam(0.01))
-    with pytest.warns(UserWarning, match="re-initializing"):
-        checkpoint.restore(vm2, checkpoint.latest(tmp_path))
+    checkpoint.restore(vm2, checkpoint.latest(tmp_path))  # no warning
     canon_equal(vm4, vm2)
-    vm2.train_batch(GPipeSchedule, N_MU, 1, ds)  # must not crash
+    vm4.train_batch(GPipeSchedule, N_MU, 1, ds)
+    vm2.train_batch(GPipeSchedule, N_MU, 1, ds)
+    # identical moments -> next steps agree up to float reassociation
+    canon_equal(vm4, vm2, rtol=2e-4, atol=1e-6)
 
 
 def test_latest_picks_highest_epoch(tmp_path, data_dir):
