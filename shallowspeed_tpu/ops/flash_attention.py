@@ -7,7 +7,7 @@ VMEM with an online softmax (the FlashAttention-2 formulation), so HBM
 traffic is O(T·D) instead of O(T²) and the MXU stays fed from on-chip
 memory.
 
-All three kernels share one streaming structure: a 3-D grid
+All kernels share one streaming structure: a 3-D grid
 (batch·kv-head, out-block, reduction-block) whose INNERMOST axis is the
 reduction, so VMEM holds one (block_q, block_k) tile's operands at a time
 — per-step VMEM is O(block²), independent of sequence length:
@@ -24,20 +24,15 @@ reduction, so VMEM holds one (block_q, block_k) tile's operands at a time
 
 Every entry point picks between this streaming form and a resident fast
 path (whole K/V — or Q/dO/stats for dkv — held in VMEM with a fori_loop
-reduction) when the sequence fits `_RESIDENT_BYTES`; resident is ~10%
-faster at T=8k (no per-tile scratch round-trips) and its causal/window
-loop bounds skip masked tiles' DMA entirely. In the streaming form,
-masked-out tiles skip their COMPUTE with `pl.when` (whole-tile Mosaic
-predication) but the grid still visits them, so their block DMA traffic
-is not saved — the FLOP savings of the old loop bounds are kept, the
-bandwidth savings only on the resident path.
+reduction) when the sequence fits `_RESIDENT_BYTES`; the resident form's
+causal/window loop bounds skip masked tiles' DMA entirely. In the
+streaming form, masked-out tiles skip their COMPUTE with `pl.when`
+(whole-tile Mosaic predication) but the grid still visits them.
 
 **Sliding windows** (`window > 0`): position i sees keys
 [i - window + 1, i] — identical semantics to `ops.attention`'s
 `window=` mask. Out-of-window k-tiles are skipped exactly like causal
-future tiles: shrunk fori_loop bounds on the resident paths (their DMA
-never issues), `pl.when` tile-liveness on the streaming paths. A long
-sequence with a small window therefore costs O(T·window), not O(T²).
+future tiles. A long sequence with a small window costs O(T·window).
 
 **Grouped-query attention** is native: pass k/v with fewer heads
 (n_kv_heads) than q and the kernels never materialize repeated K/V.
@@ -48,6 +43,18 @@ block attends against the SAME resident/streamed K/V tile, which is
 precisely the reuse GQA exists to exploit. Kernels recover logical
 positions as `row mod T` (blocks never straddle chunks since
 block_q | T). MHA is the G=1 special case — one code path.
+
+**Position offsets / ring attention.** Every kernel takes a dynamic
+scalar `rel` = (global q position) - (global k position) offset, so the
+same kernels compute any DIAGONAL CHUNK of a larger attention problem:
+masks compare `rel + local_row >= local_col`. `ring_flash_attention`
+builds sequence-parallel ring attention from these chunks — K/V blocks
+rotate over the mesh axis with `lax.ppermute` while each device merges
+its queries' per-chunk (o, lse) with the standard log-sum-exp chunk
+merge, and a hand-written VJP runs the ring again in reverse with the
+dk/dv accumulators traveling alongside the K/V blocks. Same contract as
+`ops.attention.ring_attention`, but the local compute is this fused
+kernel instead of a materialized (T_local, T_local) XLA score matrix.
 
 Wrapped in `jax.custom_vjp`, so `jax.grad` through the transformer uses the
 fused backward. On non-TPU backends the kernels run in Pallas interpret mode
@@ -80,16 +87,17 @@ def _interpret_default() -> bool:
 # Resident-K/V fast path bound: with tk*d at or under this, the whole K and
 # V comfortably fit VMEM next to the working blocks, and the single-kernel
 # fori_loop formulation avoids the streaming version's per-tile scratch
-# round-trips (~10% at T=8k measured). Above it, stream (VMEM-unbounded).
-# Byte-based (dtype-aware): 8k x 64 f32 K/V picks streaming while the same
-# shape in bf16 stays resident — an element-count gate let the f32 case
-# overflow the 16MB scoped-vmem ceiling by a hair.
+# round-trips. Above it, stream (VMEM-unbounded). Byte-based (dtype-aware):
+# 8k x 64 f32 K/V picks streaming while the same shape in bf16 stays
+# resident — an element-count gate let the f32 case overflow the 16MB
+# scoped-vmem ceiling by a hair.
 _RESIDENT_BYTES = 1 << 20  # 1MB per whole-sequence operand held in VMEM
 
 
 def _mask(s, qrow, kcol, causal, window):
     """Apply the causal and/or sliding-window mask to a score tile.
-    Returns (masked scores, validity mask or None)."""
+    `qrow`/`kcol` are GLOBAL positions (the q side already includes the
+    chunk's `rel` offset). Returns (masked scores, mask or None)."""
     valid = None
     if causal:
         valid = qrow >= kcol
@@ -101,28 +109,28 @@ def _mask(s, qrow, kcol, causal, window):
     return s, valid
 
 
-def _kblock_bounds(iqm, block_q, block_k, nkb, causal, window):
-    """fori_loop bounds over k-blocks for the q block with chunk-local
-    index `iqm` (resident fwd/dq paths). Tiles outside [lo, hi) contain
-    no unmasked entry — their DMA is never issued."""
-    lo = 0
-    hi = nkb
+def _kblock_bounds(qstart, block_q, block_k, nkb, causal, window):
+    """fori_loop bounds over k-blocks for the q block whose first GLOBAL
+    row is `qstart` (resident fwd/dq paths). Tiles outside [lo, hi)
+    contain no unmasked entry — their DMA is never issued."""
+    lo = jnp.int32(0)
+    hi = jnp.int32(nkb)
     if causal:
-        hi = jnp.minimum(nkb, (iqm * block_q + block_q - 1) // block_k + 1)
+        hi = jnp.clip((qstart + block_q - 1) // block_k + 1, 0, nkb)
     if window > 0:
-        first_col = jnp.maximum(0, iqm * block_q - (window - 1))
-        lo = first_col // block_k
+        first_col = jnp.maximum(0, qstart - (window - 1))
+        lo = jnp.clip(first_col // block_k, 0, nkb)
     return lo, hi
 
 
-def _tile_live(iqm, jk, block_q, block_k, causal, window):
-    """Whether the (iqm, jk) tile has any unmasked entry (streaming
-    paths' `pl.when` predicate). `iqm` is the chunk-local q-block index."""
+def _tile_live(qstart, jk, block_q, block_k, causal, window):
+    """Whether the tile at global-q-start `qstart`, k-block `jk` has any
+    unmasked entry (streaming paths' `pl.when` predicate)."""
     live = True
     if causal:  # last q row >= first k col
-        live = (iqm * block_q + block_q - 1) >= (jk * block_k)
+        live = (qstart + block_q - 1) >= (jk * block_k)
     if window > 0:  # last k col inside the earliest row's window
-        wlive = (jk * block_k + block_k - 1) >= (iqm * block_q - (window - 1))
+        wlive = (jk * block_k + block_k - 1) >= (qstart - (window - 1))
         live = wlive if live is True else live & wlive
     return live
 
@@ -131,19 +139,20 @@ def _tile_live(iqm, jk, block_q, block_k, causal, window):
 
 
 def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                         causal, window, block_q, block_k, seq_k,
+                         causal, window, rel, block_q, block_k, seq_k,
                          nqb_chunk):
     """Grid (bh, nqb): whole K/V resident in VMEM, fori_loop over k-blocks
     with the online-softmax carry in registers. Fast path for small T."""
     iq = pl.program_id(1)
     iqm = iq % nqb_chunk  # chunk-local block index (GQA row folding)
+    qstart = rel + iqm * block_q
     q = q_ref[:].astype(jnp.float32)                       # (bq, D)
     d = q.shape[-1]
 
     nkb = seq_k // block_k
-    lo, hi = _kblock_bounds(iqm, block_q, block_k, nkb, causal, window)
+    lo, hi = _kblock_bounds(qstart, block_q, block_k, nkb, causal, window)
 
-    qrow = iqm * block_q + jax.lax.broadcasted_iota(
+    qrow = qstart + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     def body(j, carry):
@@ -174,9 +183,9 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         m + jnp.log(jnp.maximum(l, 1e-30)), (block_q, _LANES))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, window, block_q, block_k, nkb,
-                nqb_chunk):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale, causal, window, rel, block_q,
+                block_k, nkb, nqb_chunk):
     """Grid (bh, nqb, nkb) — the K reduction is the INNERMOST grid axis,
     so VMEM holds one (block_q, block_k)-tile's operands at a time; the
     online-softmax state (m, l, acc) lives in scratch that persists
@@ -186,6 +195,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     iq = pl.program_id(1)
     jk = pl.program_id(2)
     iqm = iq % nqb_chunk
+    qstart = rel + iqm * block_q
 
     @pl.when(jk == 0)
     def _init():
@@ -193,7 +203,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    live = _tile_live(iqm, jk, block_q, block_k, causal, window)
+    live = _tile_live(qstart, jk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _accum():
@@ -201,7 +211,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         kb = k_ref[:].astype(jnp.float32)                  # (bk, D)
         vb = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        qrow = iqm * block_q + jax.lax.broadcasted_iota(
+        qrow = qstart + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         kcol = jk * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -233,12 +243,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dq_ref, *, scale, causal, window, block_q, block_k,
-                        seq_k, nqb_chunk):
+                        dq_ref, *, scale, causal, window, rel, block_q,
+                        block_k, seq_k, nqb_chunk):
     """Grid (bh, nqb): whole K/V resident in VMEM, fori_loop over k-blocks
     with shrunk causal/window bounds. Fast path for small T."""
     iq = pl.program_id(1)
     iqm = iq % nqb_chunk
+    qstart = rel + iqm * block_q
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
     lse = lse_ref[:, 0:1]
@@ -246,9 +257,9 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     d = q.shape[-1]
 
     nkb = seq_k // block_k
-    lo, hi = _kblock_bounds(iqm, block_q, block_k, nkb, causal, window)
+    lo, hi = _kblock_bounds(qstart, block_q, block_k, nkb, causal, window)
 
-    qrow = iqm * block_q + jax.lax.broadcasted_iota(
+    qrow = qstart + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
     def body(j, dq):
@@ -269,11 +280,11 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dk_ref, dv_ref, *, scale, causal, window, block_q,
-                         block_k, seq_q, nqb_chunk, groups):
+                         dk_ref, dv_ref, *, scale, causal, window, rel,
+                         block_q, block_k, seq_q, nqb_chunk, groups):
     """Grid (bh, nkb): whole Q/dO/stats resident in VMEM; for each of the
     `groups` query-head chunks (GQA row folding; static unroll), a
-    fori_loop from that chunk's first live q-block accumulates into the
+    fori_loop over that chunk's live q-blocks accumulates into the
     SHARED dk/dv block. Fast path for small T — the stats are
     (T, 128)-lane f32, so this path's VMEM grows 512B/row and is gated
     tighter than the forward's."""
@@ -282,15 +293,20 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     vb = v_ref[:].astype(jnp.float32)
     d = kb.shape[-1]
 
-    # chunk-local q-block bounds: blocks before `first` (causal) or past
-    # `last` (window) contain no unmasked entry for this k block
-    first = (jk * block_k) // block_q if causal else 0
-    if window > 0:
-        last = jnp.minimum(
-            nqb_chunk,
-            (jk * block_k + block_k - 1 + window - 1) // block_q + 1)
+    # chunk-local q-block bounds: with global row = rel + local row, a
+    # q block is live for this k block iff its last global row reaches
+    # the k block (causal) and its first global row is within window
+    if causal:
+        first = jnp.clip(
+            (jk * block_k - rel) // block_q, 0, nqb_chunk)
     else:
-        last = nqb_chunk
+        first = jnp.int32(0)
+    if window > 0:
+        last = jnp.clip(
+            (jk * block_k + block_k - 1 + window - 1 - rel) // block_q
+            + 1, 0, nqb_chunk)
+    else:
+        last = jnp.int32(nqb_chunk)
 
     kcol = jk * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
@@ -306,7 +322,7 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             delta = delta_ref[pl.ds(row0, block_q), 0:1]
             s = jnp.dot(qb, kb.T,
                         preferred_element_type=jnp.float32) * scale
-            qrow = i * block_q + jax.lax.broadcasted_iota(
+            qrow = rel + i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             s, _valid = _mask(s, qrow, kcol, causal, window)
             p = jnp.exp(s - lse)
@@ -328,24 +344,23 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, window, block_q, block_k, nqb_chunk):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, scale, causal, window, rel, block_q, block_k,
+               nqb_chunk):
     """Grid (bh, nqb, nkb) — the K reduction runs as the INNERMOST grid
-    axis so VMEM holds one (block_q, block_k)-tile's operands at a time
-    (the previous whole-sequence block specs hit the scoped-vmem ceiling
-    at T≈8k); dq_ref is the (bh, iq) block, revisited across j, f32
-    accumulated. Fully-masked causal/window tiles skip their matmuls via
-    `pl.when` (Mosaic predication), preserving the old loop-bound
-    optimization."""
+    axis so VMEM holds one (block_q, block_k)-tile's operands at a time;
+    dq_ref is the (bh, iq) block, revisited across j, f32 accumulated.
+    Fully-masked causal/window tiles skip their matmuls via `pl.when`."""
     iq = pl.program_id(1)
     jk = pl.program_id(2)
     iqm = iq % nqb_chunk
+    qstart = rel + iqm * block_q
 
     @pl.when(jk == 0)
     def _init():
         dq_ref[:] = jnp.zeros_like(dq_ref)
 
-    live = _tile_live(iqm, jk, block_q, block_k, causal, window)
+    live = _tile_live(qstart, jk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _accum():
@@ -356,7 +371,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         kb = k_ref[:].astype(jnp.float32)
         vb = v_ref[:].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-        qrow = iqm * block_q + jax.lax.broadcasted_iota(
+        qrow = qstart + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         kcol = jk * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -367,8 +382,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         dq_ref[:] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, window, block_q,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, scale, causal, window, rel, block_q,
                 block_k, nqb_chunk):
     """Grid (bh, nkb, nqb_total) — Q reduction innermost (across ALL
     query-group chunks under GQA, so group members' contributions
@@ -378,13 +393,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     jk = pl.program_id(1)
     iq = pl.program_id(2)
     iqm = iq % nqb_chunk
+    qstart = rel + iqm * block_q
 
     @pl.when(iq == 0)
     def _init():
         dk_ref[:] = jnp.zeros_like(dk_ref)
         dv_ref[:] = jnp.zeros_like(dv_ref)
 
-    live = _tile_live(iqm, jk, block_q, block_k, causal, window)
+    live = _tile_live(qstart, jk, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _accum():
@@ -395,7 +411,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[:, 0:1]
         delta = delta_ref[:, 0:1]
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        qrow = iqm * block_q + jax.lax.broadcasted_iota(
+        qrow = qstart + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         kcol = jk * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -407,7 +423,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref[:] += jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
 
 
-# ------------------------------------------------------------- entry points
+# ----------------------------------------------------- layout helpers
 
 
 def _to_bhsd(x):
@@ -457,6 +473,186 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+# ------------------------------------------------------------ chunk API
+# Folded-space primitives shared by `flash_attention` (rel = 0) and
+# `ring_flash_attention` (rel = per-step global offset). All take/return
+# (B*Hkv, rows|tk, D) arrays.
+
+
+def _chunk_fwd(q3, k3, v3, rel, *, causal, window, bq, bk, nqb_chunk,
+               interpret):
+    bh, rows, d = q3.shape
+    tk = k3.shape[1]
+    scale = 1.0 / float(np.sqrt(d))
+    out_shape = [
+        _sds((bh, rows, d), q3.dtype, q3),
+        _sds((bh, rows, _LANES), jnp.float32, q3),
+    ]
+    if tk * d * q3.dtype.itemsize <= _RESIDENT_BYTES:
+        kernel = functools.partial(
+            _fwd_kernel_resident, scale=scale, causal=causal,
+            window=window, rel=rel, block_q=bq, block_k=bk, seq_k=tk,
+            nqb_chunk=nqb_chunk)
+        return pl.pallas_call(
+            kernel,
+            grid=(bh, rows // bq),
+            in_specs=[
+                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(q3, k3, v3)
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, window=window, rel=rel,
+        block_q=bq, block_k=bk, nkb=tk // bk, nqb_chunk=nqb_chunk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, rows // bq, tk // bk),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j, k_: (i, j, 0)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((bq, _LANES), jnp.float32),  # running norm l
+            pltpu.VMEM((bq, d), jnp.float32),       # unnormalized out
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _chunk_dq(q3, k3, v3, do3, lse, delta, rel, *, causal, window, bq, bk,
+              nqb_chunk, interpret):
+    bh, rows, d = q3.shape
+    tk = k3.shape[1]
+    scale = 1.0 / float(np.sqrt(d))
+    if tk * d * q3.dtype.itemsize <= _RESIDENT_BYTES:
+        kernel = functools.partial(
+            _dq_kernel_resident, scale=scale, causal=causal,
+            window=window, rel=rel, block_q=bq, block_k=bk, seq_k=tk,
+            nqb_chunk=nqb_chunk)
+        return pl.pallas_call(
+            kernel,
+            grid=(bh, rows // bq),
+            in_specs=[
+                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            out_shape=_sds((bh, rows, d), jnp.float32, q3),
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+    kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, window=window, rel=rel,
+        block_q=bq, block_k=bk, nqb_chunk=nqb_chunk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, rows // bq, tk // bk),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j, k_: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
+        out_shape=_sds((bh, rows, d), jnp.float32, q3),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+
+def _chunk_dkv(q3, k3, v3, do3, lse, delta, rel, *, causal, window, bq,
+               bk, nqb_chunk, groups, interpret):
+    bh, rows, d = q3.shape
+    tk = k3.shape[1]
+    scale = 1.0 / float(np.sqrt(d))
+    # lse/delta stats are always f32 and get a deliberate 2x allowance;
+    # under GQA the WHOLE folded Q/dO/stats must sit in VMEM, so both
+    # gates are absolute in `rows`.
+    stats_bytes = rows * _LANES * jnp.dtype(jnp.float32).itemsize
+    resident = (rows * d * q3.dtype.itemsize <= _RESIDENT_BYTES
+                and stats_bytes <= 2 * _RESIDENT_BYTES)
+    out_shape = [
+        _sds((bh, tk, d), jnp.float32, q3),
+        _sds((bh, tk, d), jnp.float32, q3),
+    ]
+    if resident:
+        kernel = functools.partial(
+            _dkv_kernel_resident, scale=scale, causal=causal,
+            window=window, rel=rel, block_q=bq, block_k=bk,
+            seq_q=rows // groups, nqb_chunk=nqb_chunk, groups=groups)
+        return pl.pallas_call(
+            kernel,
+            grid=(bh, tk // bk),
+            in_specs=[
+                pl.BlockSpec((None, rows, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, rows, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, rows, _LANES), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, rows, _LANES), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse, delta)
+    kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal, window=window, rel=rel,
+        block_q=bq, block_k=bk, nqb_chunk=nqb_chunk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, tk // bk, rows // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda i, j, k_: (i, k_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+
+def _delta_of(do3, o3, like_lse):
+    """delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term,
+    broadcast across the 128-lane stats dim like lse."""
+    return jnp.broadcast_to(
+        jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        like_lse.shape)
+
+
+# ------------------------------------------------------------- entry points
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True, window: int = 0,
                     block_q: int = 512, block_k: int = 512,
@@ -480,76 +676,25 @@ flash_attention.supports_gqa = True
 flash_attention.supports_window = True
 
 
+def _geometry(q, k, block_q, block_k):
+    b, tq, h, d = q.shape
+    kvh = k.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(k.shape[1], block_k)
+    return b, tq, h, d, kvh, h // kvh, bq, bk, tq // bq
+
+
 def _flash_fwd(q, k, v, causal, window, block_q, block_k, interpret):
     if interpret is None:
         interpret = _interpret_default()
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
-    kvh = k.shape[2]
-    assert h % kvh == 0, (h, kvh)
-    g = h // kvh
-    bq = _pick_block(tq, block_q)
-    bk = _pick_block(tk, block_k)
-    nqb_chunk = tq // bq
-    scale = 1.0 / float(np.sqrt(d))
-    window = int(window)
-
+    b, tq, h, d, kvh, g, bq, bk, nqb_chunk = _geometry(q, k, block_q,
+                                                       block_k)
     q3 = _fold_q(q, kvh)                         # (b*kvh, g*tq, d)
     k3, v3 = _to_bhsd(k), _to_bhsd(v)            # (b*kvh, tk, d)
-    bh = b * kvh
-    rows = g * tq
-
-    out_shape = [
-        _sds((bh, rows, d), q.dtype, q3),
-        _sds((bh, rows, _LANES), jnp.float32, q3),
-    ]
-    if tk * d * q.dtype.itemsize <= _RESIDENT_BYTES:
-        kernel = functools.partial(
-            _fwd_kernel_resident, scale=scale, causal=causal,
-            window=window, block_q=bq, block_k=bk, seq_k=tk,
-            nqb_chunk=nqb_chunk)
-        o3, lse = pl.pallas_call(
-            kernel,
-            grid=(bh, rows // bq),
-            in_specs=[
-                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
-            ],
-            out_shape=out_shape,
-            interpret=interpret,
-        )(q3, k3, v3)
-    else:
-        from jax.experimental.pallas import tpu as pltpu
-
-        kernel = functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, window=window,
-            block_q=bq, block_k=bk, nkb=tk // bk, nqb_chunk=nqb_chunk)
-        o3, lse = pl.pallas_call(
-            kernel,
-            grid=(bh, rows // bq, tk // bk),
-            in_specs=[
-                pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bq, _LANES),
-                             lambda i, j, k_: (i, j, 0)),
-            ],
-            out_shape=out_shape,
-            scratch_shapes=[
-                pltpu.VMEM((bq, _LANES), jnp.float32),  # running max m
-                pltpu.VMEM((bq, _LANES), jnp.float32),  # running norm l
-                pltpu.VMEM((bq, d), jnp.float32),       # unnormalized out
-            ],
-            interpret=interpret,
-        )(q3, k3, v3)
+    o3, lse = _chunk_fwd(q3, k3, v3, 0, causal=causal, window=int(window),
+                         bq=bq, bk=bk, nqb_chunk=nqb_chunk,
+                         interpret=interpret)
     o = _unfold_q(o3, b, h)
     return o, (q, k, v, o, lse)
 
@@ -564,139 +709,191 @@ def _flash_bwd_rule(causal, window, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
     if interpret is None:
         interpret = _interpret_default()
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
-    kvh = k.shape[2]
-    g = h // kvh
-    bq = _pick_block(tq, block_q)
-    bk = _pick_block(tk, block_k)
-    nqb_chunk = tq // bq
-    scale = 1.0 / float(np.sqrt(d))
+    b, tq, h, d, kvh, g, bq, bk, nqb_chunk = _geometry(q, k, block_q,
+                                                       block_k)
     window = int(window)
-    bh = b * kvh
-    rows = g * tq
-
     q3, k3, v3 = _fold_q(q, kvh), _to_bhsd(k), _to_bhsd(v)
     o3, do3 = _fold_q(o, kvh), _fold_q(do, kvh)
-    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term,
-    # broadcast across the 128-lane stats dim like lse
-    delta = jnp.broadcast_to(
-        jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
-                axis=-1, keepdims=True),
-        lse.shape)
-
-    # Resident fast paths when the whole-sequence operands fit VMEM (the
-    # dkv kernel's 128-lane f32 stats are the tight constraint); beyond
-    # that, the reduction axis runs as the innermost grid dimension
-    # revisiting an f32 output block — VMEM per step is O(block^2),
-    # independent of T.
-    dq_resident = tk * d * q.dtype.itemsize <= _RESIDENT_BYTES
-    if dq_resident:
-        dq_kernel = functools.partial(
-            _dq_kernel_resident, scale=scale, causal=causal, window=window,
-            block_q=bq, block_k=bk, seq_k=tk, nqb_chunk=nqb_chunk)
-        dq3 = pl.pallas_call(
-            dq_kernel,
-            grid=(bh, rows // bq),
-            in_specs=[
-                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, tk, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, bq, _LANES), lambda i, j: (i, j, 0)),
-            ],
-            out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            out_shape=_sds((bh, rows, d), jnp.float32, q3),
-            interpret=interpret,
-        )(q3, k3, v3, do3, lse, delta)
-    else:
-        dq_kernel = functools.partial(
-            _dq_kernel, scale=scale, causal=causal, window=window,
-            block_q=bq, block_k=bk, nqb_chunk=nqb_chunk)
-        dq3 = pl.pallas_call(
-            dq_kernel,
-            grid=(bh, rows // bq, tk // bk),
-            in_specs=[
-                pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, k_, 0)),
-                pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bq, _LANES),
-                             lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bq, _LANES),
-                             lambda i, j, k_: (i, j, 0)),
-            ],
-            out_specs=pl.BlockSpec((None, bq, d),
-                                   lambda i, j, k_: (i, j, 0)),
-            out_shape=_sds((bh, rows, d), jnp.float32, q3),
-            interpret=interpret,
-        )(q3, k3, v3, do3, lse, delta)
-
-    # lse/delta stats are always f32 and get a deliberate 2x allowance
-    # (preserves the pre-byte-gate bound: bf16 resident up to T=4096).
-    # Under GQA the folded row space is g*tq long and the WHOLE folded
-    # Q/dO/stats must sit in VMEM, so both gates are absolute in `rows`.
-    stats_bytes = rows * _LANES * jnp.dtype(jnp.float32).itemsize
-    dkv_resident = (rows * d * q.dtype.itemsize <= _RESIDENT_BYTES
-                    and stats_bytes <= 2 * _RESIDENT_BYTES)
-    if dkv_resident:
-        dkv_kernel = functools.partial(
-            _dkv_kernel_resident, scale=scale, causal=causal,
-            window=window, block_q=bq, block_k=bk, seq_q=tq,
-            nqb_chunk=nqb_chunk, groups=g)
-        dk3, dv3 = pl.pallas_call(
-            dkv_kernel,
-            grid=(bh, tk // bk),
-            in_specs=[
-                pl.BlockSpec((None, rows, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, rows, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, rows, _LANES), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, rows, _LANES), lambda i, j: (i, 0, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-            ],
-            out_shape=[
-                _sds((bh, tk, d), jnp.float32, q3),
-                _sds((bh, tk, d), jnp.float32, q3),
-            ],
-            interpret=interpret,
-        )(q3, k3, v3, do3, lse, delta)
-    else:
-        dkv_kernel = functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, window=window,
-            block_q=bq, block_k=bk, nqb_chunk=nqb_chunk)
-        dk3, dv3 = pl.pallas_call(
-            dkv_kernel,
-            grid=(bh, tk // bk, rows // bq),
-            in_specs=[
-                pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, k_, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bq, d), lambda i, j, k_: (i, k_, 0)),
-                pl.BlockSpec((None, bq, _LANES),
-                             lambda i, j, k_: (i, k_, 0)),
-                pl.BlockSpec((None, bq, _LANES),
-                             lambda i, j, k_: (i, k_, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j, k_: (i, j, 0)),
-            ],
-            out_shape=[
-                _sds((bh, tk, d), jnp.float32, q3),
-                _sds((bh, tk, d), jnp.float32, q3),
-            ],
-            interpret=interpret,
-        )(q3, k3, v3, do3, lse, delta)
-
+    delta = _delta_of(do3, o3, lse)
+    kw = dict(causal=causal, window=window, bq=bq, bk=bk,
+              nqb_chunk=nqb_chunk, interpret=interpret)
+    dq3 = _chunk_dq(q3, k3, v3, do3, lse, delta, 0, **kw)
+    dk3, dv3 = _chunk_dkv(q3, k3, v3, do3, lse, delta, 0, groups=g, **kw)
     return (_unfold_q(dq3, b, h).astype(q.dtype),
             _from_bhsd(dk3, b, kvh).astype(k.dtype),
             _from_bhsd(dv3, b, kvh).astype(v.dtype))
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ------------------------------------------------------------ ring flash
+
+
+def _merge_chunks(o_acc, lse_acc, o_i, lse_i):
+    """Standard log-sum-exp merge of two normalized attention chunks:
+    each o is a softmax-weighted average with total mass exp(lse).
+    lse carries the 128-lane stats dim (all lanes identical); the o
+    weighting uses lane 0."""
+    m = jnp.maximum(lse_acc, lse_i)
+    a = jnp.exp(lse_acc - m)                    # (bh, rows, _LANES)
+    b = jnp.exp(lse_i - m)
+    denom = jnp.maximum(a + b, 1e-30)
+    o = (o_acc * a[..., 0:1] + o_i.astype(jnp.float32) * b[..., 0:1]) \
+        / denom[..., 0:1]
+    return o, m + jnp.log(denom)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = True,
+                         window: int = 0):
+    """Ring attention with the fused flash kernel as the local compute.
+
+    Same contract as `ops.attention.ring_attention` (q: (batch,
+    seq_local, heads, head_dim); k/v may carry fewer GQA kv heads; the
+    global sequence is the concatenation of blocks in mesh-axis order),
+    but each ring step runs the blockwise Pallas kernel on its
+    (local q) x (visiting K/V block) chunk — masks offset by the chunk's
+    global position delta, out-of-range tiles skipped — instead of
+    materializing a (T_local, T_local) XLA score matrix. Per-chunk
+    (o, lse) merge with the standard log-sum-exp rule; the hand-written
+    VJP rides the ring in reverse with dk/dv accumulators traveling
+    alongside the K/V blocks (each block collects its gradient from
+    every query shard exactly once, then arrives home)."""
+    o, _ = _ring_fwd(q, k, v, axis_name, causal, window)
+    return o
+
+
+ring_flash_attention.supports_gqa = True
+ring_flash_attention.supports_window = True
+
+
+def _ring_geometry(q, k):
+    b, t, h, d = q.shape
+    kvh = k.shape[2]
+    assert h % kvh == 0, (h, kvh)
+    bq = _pick_block(t, 512)
+    bk = _pick_block(k.shape[1], 512)
+    return b, t, h, d, kvh, h // kvh, bq, bk, t // bq
+
+
+def _ring_fwd(q, k, v, axis_name, causal, window):
+    from jax import lax
+
+    interpret = _interpret_default()
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t, h, d, kvh, g, bq, bk, nqb_chunk = _ring_geometry(q, k)
+    window = int(window)
+    q3 = _fold_q(q, kvh)
+    k3, v3 = _to_bhsd(k), _to_bhsd(v)
+    kw = dict(causal=causal, window=window, bq=bq, bk=bk,
+              nqb_chunk=nqb_chunk, interpret=interpret)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # Ring step i: device idx holds the K/V block of device (idx - i)
+    # mod n, so the position offset rel = (global q start) - (global k
+    # start) is i*t when idx >= i and (i-n)*t otherwise. The step count
+    # n is STATIC (mesh axis size), so the ring unrolls as a Python loop
+    # and each chunk gets a COMPILE-TIME rel — kernels stay free of
+    # dynamic scalars, and under causal masking the idx < i branch
+    # (q entirely before the visiting block) skips its kernels outright.
+    zq = q3.astype(jnp.float32).sum() * 0.0
+    o3 = jnp.zeros(q3.shape, jnp.float32) + zq
+    lse = jnp.full((q3.shape[0], q3.shape[1], _LANES), _NEG) + zq
+    kb, vb = k3, v3
+    for i in range(n):
+        if i == 0:
+            o3, lse = _merge_chunks(o3, lse, *_chunk_fwd(q3, kb, vb, 0,
+                                                         **kw))
+        elif causal and window == 0:
+            # future block on idx < i: fully masked — skip the kernel
+            def live(ops, i=i):
+                return _merge_chunks(ops[0], ops[1], *_chunk_fwd(
+                    q3, ops[2], ops[3], i * t, **kw))
+
+            o3, lse = lax.cond(idx >= i, live,
+                               lambda ops: (ops[0], ops[1]),
+                               (o3, lse, kb, vb))
+        else:
+            def fwd_at(rel):
+                def f(ops):
+                    return _merge_chunks(ops[0], ops[1], *_chunk_fwd(
+                        q3, ops[2], ops[3], rel, **kw))
+
+                return f
+
+            o3, lse = lax.cond(idx >= i, fwd_at(i * t),
+                               fwd_at((i - n) * t), (o3, lse, kb, vb))
+        if i + 1 < n:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+    o = _unfold_q(o3.astype(q.dtype), b, h)
+    return o, (q, k, v, _unfold_q(o3, b, h), lse)
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, window):
+    o, res = _ring_fwd(q, k, v, axis_name, causal, window)
+    return o, res
+
+
+def _ring_bwd_rule(axis_name, causal, window, res, do):
+    from jax import lax
+
+    q, k, v, o_f32, lse = res
+    interpret = _interpret_default()
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t, h, d, kvh, g, bq, bk, nqb_chunk = _ring_geometry(q, k)
+    window = int(window)
+    q3, k3, v3 = _fold_q(q, kvh), _to_bhsd(k), _to_bhsd(v)
+    o3, do3 = _fold_q(o_f32, kvh), _fold_q(do, kvh)
+    delta = _delta_of(do3, o3, lse)
+    kw = dict(causal=causal, window=window, bq=bq, bk=bk,
+              nqb_chunk=nqb_chunk, interpret=interpret)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    # Reverse ring, same static-rel unrolling as the forward. dk/dv
+    # accumulators travel WITH their K/V block (rotated together every
+    # hop): after n hops each block is home, having collected its
+    # gradient contribution from every query shard exactly once.
+    zq = q3.astype(jnp.float32).sum() * 0.0
+    dq3 = jnp.zeros(q3.shape, jnp.float32) + zq
+    dkb = jnp.zeros(k3.shape, jnp.float32) + zq
+    dvb = jnp.zeros(k3.shape, jnp.float32) + zq
+    kb, vb = k3, v3
+
+    def contrib_at(rel):
+        def f(ops):
+            dq, dkb, dvb, kb, vb = ops
+            dq = dq + _chunk_dq(q3, kb, vb, do3, lse, delta, rel, **kw)
+            dk_i, dv_i = _chunk_dkv(q3, kb, vb, do3, lse, delta, rel,
+                                    groups=g, **kw)
+            return dq, dkb + dk_i, dvb + dv_i
+
+        return f
+
+    for i in range(n):
+        ops = (dq3, dkb, dvb, kb, vb)
+        if i == 0:
+            dq3, dkb, dvb = contrib_at(0)(ops)
+        elif causal and window == 0:
+            dq3, dkb, dvb = lax.cond(
+                idx >= i, contrib_at(i * t),
+                lambda ops: (ops[0], ops[1], ops[2]), ops)
+        else:
+            dq3, dkb, dvb = lax.cond(
+                idx >= i, contrib_at(i * t), contrib_at((i - n) * t),
+                ops)
+        # rotate grads with their block; the LAST hop brings every
+        # block's accumulator home (unlike the fwd, this hop is needed)
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+    return (_unfold_q(dq3, b, h).astype(q.dtype),
+            _from_bhsd(dkb, b, kvh).astype(k.dtype),
+            _from_bhsd(dvb, b, kvh).astype(v.dtype))
+
+
+ring_flash_attention.defvjp(_ring_fwd_rule, _ring_bwd_rule)

@@ -99,6 +99,15 @@ class ContextParallelEngine:
                 f"({cfg.kv_heads}) divisible by sp ({self.sp}); use ring")
             attn = partial(ulysses_attention, axis_name="sp", causal=True,
                            window=w, use_flash=attn == "ulysses-flash")
+        elif attn == "ring-flash":
+            from shallowspeed_tpu.ops.flash_attention import (
+                ring_flash_attention)
+
+            # the fused kernel as the ring's local compute: no
+            # (T_local, T_local) score matrix, no head-divisibility
+            # constraint — works for ANY sp (unlike ulysses)
+            attn = partial(ring_flash_attention, axis_name="sp",
+                           causal=True, window=w)
         else:
             attn = partial(ring_attention, axis_name="sp", causal=True,
                            window=w)

@@ -140,6 +140,8 @@ def test_window_composes_with_fused_substrates():
         "ring-sp2": ContextParallelEngine(cfg, SGD(0.1), mesh_sp, seed=0),
         "ulysses-flash-sp2": ContextParallelEngine(
             cfg, SGD(0.1), mesh_sp, seed=0, attn="ulysses-flash"),
+        "ring-flash-sp2": ContextParallelEngine(
+            cfg, SGD(0.1), mesh_sp, seed=0, attn="ring-flash"),
         "pipeline-flash": PipelineLMEngine(
             cfg, SGD(0.1),
             Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "pp")),

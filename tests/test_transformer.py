@@ -104,6 +104,25 @@ def test_ulysses_engine_matches_ring_engine():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_ring_flash_engine_matches_ring_engine():
+    """attn='ring-flash' (the fused kernel as the ring's local compute,
+    round 2) trains identically to attn='ring' on a sequence-sharded
+    (dp=2, sp=2) mesh — including an sp that does NOT divide n_heads,
+    where ulysses cannot go."""
+    ring = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 2), seed=3)
+    rf = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 2), seed=3,
+                               attn="ring-flash")
+    for b in range(2):
+        tok, tgt = toy_batch(seed=b)
+        lr = ring.train_batch(tok, tgt)
+        lf = rf.train_batch(tok, tgt)
+        assert abs(lr - lf) < 1e-5, (lr, lf)
+    for a, b in zip(jax.tree_util.tree_leaves(ring.params),
+                    jax.tree_util.tree_leaves(rf.params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_logits_match_full_attention_reference():
     """Sharded inference logits == direct full-attention forward."""
     eng = ContextParallelEngine(CFG, SGD(0.1), make_mesh(2, 4), seed=9)

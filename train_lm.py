@@ -130,8 +130,12 @@ def parse_args(argv=None):
                         "DP reduction becomes a reduce-scatter and the "
                         "persistent grad buffer is 1/dp per device")
     p.add_argument("--attn", default="ring",
-                   choices=["ring", "ulysses", "ulysses-flash", "flash"],
-                   help="attention substrate: ring (any --sp), ulysses "
+                   choices=["ring", "ring-flash", "ulysses",
+                            "ulysses-flash", "flash"],
+                   help="attention substrate: ring (any --sp; XLA local "
+                        "compute), ring-flash (any --sp; the fused "
+                        "Pallas kernel as the ring's local compute — no "
+                        "head-divisibility constraint), ulysses "
                         "(all-to-all; needs n_heads %% sp == 0), "
                         "ulysses-flash (all-to-all + fused Pallas kernel) "
                         "or the fused Pallas flash kernel (--sp 1 only; "
