@@ -11,6 +11,11 @@ from shallowspeed_tpu.ops.functional import (  # noqa: F401
 from shallowspeed_tpu.ops.attention import (  # noqa: F401
     attention,
     ring_attention,
+    ulysses_attention,
+)
+from shallowspeed_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    ring_flash_attention,
 )
 from shallowspeed_tpu.ops.moe import (  # noqa: F401
     expert_capacity,
