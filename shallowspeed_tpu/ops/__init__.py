@@ -13,8 +13,12 @@ from shallowspeed_tpu.ops.attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+# NOTE: the `flash_attention` FUNCTION is deliberately not re-exported
+# here — binding that name on the package would shadow the
+# `ops.flash_attention` SUBMODULE attribute and break
+# `import shallowspeed_tpu.ops.flash_attention as fa` (the function name
+# equals its module name). Import it from the submodule.
 from shallowspeed_tpu.ops.flash_attention import (  # noqa: F401
-    flash_attention,
     ring_flash_attention,
 )
 from shallowspeed_tpu.ops.moe import (  # noqa: F401
