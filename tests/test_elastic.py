@@ -134,3 +134,21 @@ def test_auto_resume_fresh_then_resume(tmp_path):
                         text=True, cwd=repo, timeout=300)
     assert r2.returncode == 0, r2.stdout + r2.stderr
     assert "resumed from" in r2.stdout  # picked up ckpt_3
+
+
+def test_auto_resume_mlp_driver(tmp_path):
+    """The reference-parity MLP driver honors --auto-resume the same way
+    (fresh without a checkpoint, resumed with one)."""
+    base = [sys.executable, "train.py", "--platform", "cpu",
+            "--host-devices", "2", "--dp", "2", "--max-batches", "4",
+            "--lr", "0.5", "--save-dir", str(tmp_path / "ck"),
+            "--auto-resume"]
+    repo = Path(__file__).parent.parent
+    r1 = subprocess.run(base + ["--epochs", "1"], capture_output=True,
+                        text=True, cwd=repo, timeout=300)
+    assert r1.returncode == 0, r1.stdout + r1.stderr
+    assert "resumed" not in r1.stdout
+    r2 = subprocess.run(base + ["--epochs", "2"], capture_output=True,
+                        text=True, cwd=repo, timeout=300)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "resumed from" in r2.stdout

@@ -58,6 +58,10 @@ def parse_args(argv=None):
                    help="checkpoint directory; saves after every epoch")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --save-dir")
+    p.add_argument("--auto-resume", action="store_true",
+                   help="resume from the latest checkpoint if one exists, "
+                        "start fresh otherwise (restart-safe; pairs with "
+                        "the elastic supervisor, shallowspeed_tpu.elastic)")
     p.add_argument("--profile-dir", type=str, default="",
                    help="write a jax.profiler trace of the training epochs")
     p.add_argument("--log-file", type=str, default="",
@@ -203,6 +207,12 @@ def train(args) -> float:
         n_batches = min(n_batches, args.max_batches)
 
     start_epoch = 0
+    if args.auto_resume and not args.resume:
+        # elastic restarts: resume iff a checkpoint exists, else fresh
+        if not args.save_dir:
+            raise SystemExit("--auto-resume requires --save-dir")
+        if checkpoint.latest(args.save_dir) is not None:
+            args.resume = True
     if args.resume:
         if not args.save_dir:
             raise SystemExit("--resume requires --save-dir")
