@@ -91,7 +91,8 @@ def main():
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--ffn", default="swiglu", choices=["gelu", "swiglu"])
     ap.add_argument("--attn", default="flash",
-                    choices=["flash", "ring", "ulysses", "ulysses-flash"])
+                    choices=["flash", "ring", "ring-flash", "ulysses",
+                             "ulysses-flash"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--remat", action="store_true")
     args = ap.parse_args()
