@@ -178,3 +178,35 @@ def test_decode_scan_traces_once(monkeypatch):
     out2 = G.generate(params, toks(seed=1, t=8), CFG, 16, temperature=0.0)
     jax.block_until_ready(out2)
     assert calls["n"] == first, "same-shape generation retraced the scan"
+
+
+def test_generate_with_sharded_params():
+    """Distributed inference falls out of the design: `generate` is one
+    jitted program, so GSPMD propagates a TP/FSDP engine's parameter
+    shardings through prefill, the cache, and the decode scan — greedy
+    outputs must match the replicated-params decode token for token."""
+    from jax.sharding import Mesh
+
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.fsdp import FSDPEngine
+    from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
+
+    prompt = toks(seed=3, b=2, t=8)
+    ref = np.asarray(generate(jax.device_put(T.init(CFG, seed=0)), prompt,
+                              CFG, 8, temperature=0.0))
+
+    tp = TensorParallelEngine(
+        CFG, SGD(0.1),
+        Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp")),
+        seed=0)
+    np.testing.assert_array_equal(
+        np.asarray(generate(tp.params, prompt, CFG, 8, temperature=0.0)),
+        ref)
+
+    fsdp = FSDPEngine(
+        CFG, SGD(0.1),
+        Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",)), seed=0)
+    np.testing.assert_array_equal(
+        np.asarray(generate(fsdp.params, prompt, CFG, 8,
+                            temperature=0.0)),
+        ref)
