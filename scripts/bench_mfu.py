@@ -29,18 +29,21 @@ def run(args) -> dict:
 
     from shallowspeed_tpu.flops import mfu, transformer_flops_per_token
     from shallowspeed_tpu.models.transformer import TransformerConfig
-    from shallowspeed_tpu.optim import AdamW
+    from shallowspeed_tpu.optim import Adafactor, AdamW
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
 
     cfg = TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, max_seq=args.seq_len,
         dtype=np.float32, compute_dtype=np.dtype("bfloat16"),
-        rope=True, norm="rmsnorm", ffn=args.ffn, remat=args.remat)
+        rope=True, norm="rmsnorm", ffn=args.ffn, remat=args.remat,
+        remat_policy=args.remat_policy, xent_chunk=args.xent_chunk)
+    opt = (Adafactor(3e-4) if args.optimizer == "adafactor"
+           else AdamW(3e-4))
     devs = np.array(jax.devices()[:1])
     mesh = Mesh(devs.reshape(1, 1), ("dp", "sp"))
-    eng = ContextParallelEngine(cfg, AdamW(3e-4), mesh, seed=0,
-                                attn=args.attn)
+    eng = ContextParallelEngine(cfg, opt, mesh, seed=0,
+                                attn=args.attn, accum=args.accum)
 
     rng = np.random.default_rng(0)
     toks = rng.integers(0, args.vocab,
@@ -68,6 +71,9 @@ def run(args) -> dict:
             "n_heads": args.n_heads, "seq_len": args.seq_len,
             "batch": args.batch_size, "vocab": args.vocab,
             "ffn": args.ffn, "attn": args.attn, "remat": args.remat,
+            "remat_policy": args.remat_policy,
+            "xent_chunk": args.xent_chunk, "accum": args.accum,
+            "optimizer": args.optimizer,
             "params_m": round(sum(
                 x.size for x in jax.tree_util.tree_leaves(eng.params))
                 / 1e6, 1),
@@ -95,6 +101,12 @@ def main():
                              "ulysses-flash"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "attn", "dots"])
+    ap.add_argument("--xent-chunk", type=int, default=0)
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--optimizer", default="adamw",
+                    choices=["adamw", "adafactor"])
     args = ap.parse_args()
     print(json.dumps(run(args)))
 

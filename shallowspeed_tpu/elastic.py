@@ -123,16 +123,22 @@ class Supervisor:
             except OSError:
                 open(self.heartbeat_file, "w").close()
         child = subprocess.Popen(self.argv)
+        # staleness floor: if the heartbeat file disappears mid-run
+        # (deleted, tmpfs wipe), measure staleness from the last KNOWN
+        # beat — child start at worst — instead of silently disabling
+        # hang detection for the rest of the child's life (ADVICE r2)
+        hb_seen = time.time()
         while True:
             code = child.poll()
             if code is not None:
                 return code, time.monotonic() - t0
             if self.hang_timeout is not None:
                 try:
-                    stale = time.time() - os.path.getmtime(
-                        self.heartbeat_file)
+                    hb_seen = max(hb_seen,
+                                  os.path.getmtime(self.heartbeat_file))
                 except OSError:
-                    stale = 0.0
+                    pass
+                stale = time.time() - hb_seen
                 if stale > self.hang_timeout:
                     self.log(f"[elastic] heartbeat stale {stale:.0f}s > "
                              f"{self.hang_timeout}s — killing child "

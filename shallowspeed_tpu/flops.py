@@ -80,7 +80,9 @@ def transformer_flops_per_token(cfg, seq_len: int,
     as is standard (they are HBM-bound, not MXU work).
     """
     d = cfg.d_model
-    ff = 4 * d
+    # one source of truth with transformer.init (ADVICE r2: a hardcoded
+    # 4*d here would silently misreport MFU if d_ff ever diverges)
+    ff = cfg.ffn_dim
     per_layer = 0.0
     # attention projections
     if cfg.gqa:

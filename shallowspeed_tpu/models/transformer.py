@@ -26,6 +26,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 
 from shallowspeed_tpu.ops.attention import attention
 from shallowspeed_tpu.ops.moe import moe_ffn
@@ -53,6 +54,20 @@ class TransformerConfig:
     # ~1 extra forward of FLOPs for O(n_layers) -> O(1) activation memory —
     # the standard long-context lever on HBM-bound TPUs.
     remat: bool = False
+    # What the per-block checkpoint SAVES (only read when remat=True):
+    # - "full": save nothing, recompute the whole block (max memory saving,
+    #   +~1 forward of FLOPs — the round-2 behavior).
+    # - "attn": save each block's attention output (tagged "attn_out"
+    #   below) — the backward replays the cheap projections/FFN but never
+    #   re-runs the attention substrate (the flash kernel's forward is the
+    #   expensive, bandwidth-bound part of the replay). +(B,T,d) bf16 per
+    #   block.
+    # - "dots": save every matmul output AND the attention output;
+    #   backward recomputes only elementwise ops (norms, gelu/silu,
+    #   rotary). Near-zero recompute FLOPs at ~14*d bytes/token per block
+    #   — the right point when activations fit (e.g. microbatched big
+    #   models); "full" remains the extreme-length fallback.
+    remat_policy: str = "full"
     # Rotary position embeddings (Su et al., RoFormer): rotate q/k by
     # per-position phases inside every block instead of adding a learned
     # absolute embedding (pos_emb is kept in the pytree for structural
@@ -120,10 +135,25 @@ class TransformerConfig:
     # deterministically from (step, microbatch, layer), which makes the
     # masks reproducible under remat and 1F1B vjp recompute.
     dropout: float = 0.0
+    # FFN hidden width; 0 = the classic 4*d_model. One knob shared by
+    # init, the forward, and the FLOPs accounting (`flops.py`) so the
+    # three can never drift.
+    d_ff: int = 0
+    # Chunked (blockwise) cross-entropy: compute the loss in chunks of
+    # this many token positions, rematerializing each chunk's logits in
+    # the backward — the (B*T, vocab) logits/log-probabilities are never
+    # materialized or stored at once. 0 = classic whole-batch
+    # log-softmax. Essential for large-vocab configs: at vocab 32k,
+    # B*T=8k the classic path writes a ~1GB f32 log-prob residual;
+    # chunked keeps O(chunk * vocab) transients only.
+    xent_chunk: int = 0
 
     def __post_init__(self):
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
         assert self.ffn in ("gelu", "swiglu"), self.ffn
+        assert self.remat_policy in ("full", "attn", "dots"), \
+            self.remat_policy
+        assert self.xent_chunk >= 0, self.xent_chunk
         assert 0.0 <= self.dropout < 1.0, self.dropout
         assert 0.0 <= self.label_smoothing < 1.0, self.label_smoothing
         assert self.attn_window >= 0, self.attn_window
@@ -137,6 +167,10 @@ class TransformerConfig:
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
 
     @property
     def kv_heads(self) -> int:
@@ -172,9 +206,9 @@ def init(cfg: TransformerConfig, seed: int = 0):
         else:
             blk["qkv"] = _dense_init(rng, d, 3 * d, dt)
         if cfg.ffn == "swiglu" and cfg.n_experts == 0:
-            blk["gate"] = _dense_init(rng, d, 4 * d, dt)
+            blk["gate"] = _dense_init(rng, d, cfg.ffn_dim, dt)
         if cfg.n_experts > 0:
-            e, ff = cfg.n_experts, 4 * d
+            e, ff = cfg.n_experts, cfg.ffn_dim
             blk["moe"] = {
                 "gate": rng.normal(0.0, 0.02, (d, e)).astype(dt),
                 "wi": rng.normal(0.0, 1.0 / np.sqrt(d), (e, d, ff)).astype(dt),
@@ -183,8 +217,8 @@ def init(cfg: TransformerConfig, seed: int = 0):
                 "bo": np.zeros((e, d), dt),
             }
         else:
-            blk["up"] = _dense_init(rng, d, 4 * d, dt)
-            blk["down"] = _dense_init(rng, 4 * d, d, dt)
+            blk["up"] = _dense_init(rng, d, cfg.ffn_dim, dt)
+            blk["down"] = _dense_init(rng, cfg.ffn_dim, d, dt)
         blocks.append(blk)
     out = {
         "tok_emb": rng.normal(0.0, 0.02, (cfg.vocab, d)).astype(dt),
@@ -274,6 +308,78 @@ def token_loss(logits, targets, cfg: TransformerConfig,
     if train and ls > 0.0:
         nll = (1.0 - ls) * nll + ls * (-logp.mean(axis=-1))
     return nll.mean()
+
+
+def _remat_policy(cfg: TransformerConfig):
+    """jax.checkpoint policy for cfg.remat_policy (None = save nothing)."""
+    cp = jax.checkpoint_policies
+    if cfg.remat_policy == "attn":
+        return cp.save_only_these_names("attn_out")
+    if cfg.remat_policy == "dots":
+        return cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable,
+            cp.save_only_these_names("attn_out"))
+    return None
+
+
+def chunked_token_loss(params, x, targets, cfg: TransformerConfig,
+                       train: bool = True):
+    """`token_loss(head_logits(x))` without ever materializing the
+    (B*T, vocab) logits: positions are processed in chunks of
+    cfg.xent_chunk under a `lax.scan`, each chunk's logits/logsumexp
+    rematerialized in the backward (`jax.checkpoint`), so peak memory is
+    O(chunk * vocab) transients plus the scalar carry — vs the classic
+    path's full f32 log-prob residual. Numerically it computes the SAME
+    quantity (lse - target logit, f32 reductions over the same bf16
+    logits), reassociated per chunk.
+
+    `params` is the UNCAST tree; only the head leaves are cast here (XLA
+    CSEs the duplicate cast against the forward's). `x` is the final-norm
+    output (B, T, d)."""
+    if cfg.tie_embeddings:
+        hp = {"tok_emb": params["tok_emb"]}
+    else:
+        hp = {"head": params["head"]}
+    hp = cast_params(hp, cfg.compute_dtype)
+    b, t, d = x.shape
+    total = b * t
+    n = min(cfg.xent_chunk, total)
+    xf = x.reshape(total, d)
+    tf = targets.reshape(total)
+    rem = (-total) % n
+    ls = cfg.label_smoothing if train else 0.0
+    if rem:  # pad to a whole number of chunks; mask the pad rows out
+        xf = jnp.pad(xf, ((0, rem), (0, 0)))
+        tf = jnp.pad(tf, (0, rem))
+        wf = jnp.pad(jnp.ones((total,), jnp.float32), (0, rem))
+    else:
+        wf = jnp.ones((total,), jnp.float32)
+
+    def chunk_nll(hp, xc, tc, wc):
+        logits = head_logits(hp, xc, cfg).astype(jnp.float32)  # (n, V)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        nll = lse - tgt
+        if ls > 0.0:
+            # -mean logp = lse - mean(logits); same algebra as token_loss
+            nll = (1.0 - ls) * nll + ls * (lse - logits.mean(axis=-1))
+        return (nll * wc).sum()
+
+    body = jax.checkpoint(chunk_nll)
+    k = xf.shape[0] // n
+
+    def sbody(acc, xs):
+        return acc + body(hp, *xs), None
+
+    # the accumulator must carry x's mesh-variance type (inside a
+    # shard_map the per-chunk sums are device-varying; a plain 0.0 is
+    # invariant and the scan would reject the carry) — deriving the
+    # zero from x itself inherits the right type at zero cost
+    acc0 = (xf[0, 0] * 0).astype(jnp.float32)
+    tot, _ = jax.lax.scan(
+        sbody, acc0,
+        (xf.reshape(k, n, d), tf.reshape(k, n), wf.reshape(k, n)))
+    return tot / total
 
 
 def rope_rotate(x, pos, theta: float = 10000.0):
@@ -378,6 +484,10 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
         a = attn_fn(q, k, v).reshape(b, t, d)
     else:
         a = attn_fn(q, repeat_kv(k, cfg), repeat_kv(v, cfg)).reshape(b, t, d)
+    # name for selective remat: cfg.remat_policy "attn"/"dots" saves this
+    # value so the backward replay never re-runs the attention substrate
+    # (no-op outside a policied jax.checkpoint)
+    a = _checkpoint_name(a, "attn_out")
     x = x + _dropout(_dense(p["proj"], a), cfg.dropout, k_attn)
     h = _norm(p["ln2"], x, cfg)
     x, aux = _ffn(p, x, cfg, h, k_ffn)
@@ -388,8 +498,12 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
 
 def forward_with_aux(params, tokens, cfg: TransformerConfig,
                      attn_fn=None, pos_offset=0, dropout_key=None,
-                     with_stats: bool = False):
+                     with_stats: bool = False, head: bool = True):
     """tokens: (batch, seq) int32 -> (logits (batch, seq, vocab), moe aux).
+
+    `head=False` returns the final-norm hidden states (batch, seq, d)
+    instead of logits — the chunked-cross-entropy path (`loss` with
+    cfg.xent_chunk) applies the vocab projection itself, blockwise.
 
     With `with_stats=True` additionally returns layer-averaged MoE
     routing statistics ({"load": (E,), "drop_fraction": scalar}, or None
@@ -428,7 +542,8 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
     stats_sum, n_moe = None, 0
     block_fn = _block
     if cfg.remat:
-        block_fn = jax.checkpoint(_block, static_argnums=(2, 3, 4))
+        block_fn = jax.checkpoint(_block, static_argnums=(2, 3, 4),
+                                  policy=_remat_policy(cfg))
     for i, blk in enumerate(params["blocks"]):
         k_i = (None if dropout_key is None
                else jax.random.fold_in(dropout_key, i))
@@ -440,12 +555,12 @@ def forward_with_aux(params, tokens, cfg: TransformerConfig,
                          jax.tree_util.tree_map(jnp.add, stats_sum, st))
             n_moe += 1
     x = _norm(params["ln_f"], x, cfg)
-    logits = head_logits(params, x, cfg)
+    out = head_logits(params, x, cfg) if head else x
     if with_stats:
         stats = (None if stats_sum is None else jax.tree_util.tree_map(
             lambda v: v / n_moe, stats_sum))
-        return logits, (aux_total, z_total), stats
-    return logits, (aux_total, z_total)
+        return out, (aux_total, z_total), stats
+    return out, (aux_total, z_total)
 
 
 def forward(params, tokens, cfg: TransformerConfig,
@@ -464,9 +579,16 @@ def loss(params, tokens, targets, cfg: TransformerConfig,
     the caller averages across shards (`lax.pmean`) — exact because all
     blocks have equal size.
     """
-    logits, (aux, z) = forward_with_aux(params, tokens, cfg, attn_fn,
-                                        pos_offset, dropout_key)
-    total = token_loss(logits, targets, cfg, train) + cfg.moe_aux_weight * aux
+    if cfg.xent_chunk > 0:
+        hid, (aux, z) = forward_with_aux(params, tokens, cfg, attn_fn,
+                                         pos_offset, dropout_key,
+                                         head=False)
+        tl = chunked_token_loss(params, hid, targets, cfg, train)
+    else:
+        logits, (aux, z) = forward_with_aux(params, tokens, cfg, attn_fn,
+                                            pos_offset, dropout_key)
+        tl = token_loss(logits, targets, cfg, train)
+    total = tl + cfg.moe_aux_weight * aux
     if cfg.moe_z_weight > 0.0:
         total = total + cfg.moe_z_weight * z
     return total

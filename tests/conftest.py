@@ -25,9 +25,24 @@ import pytest
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_multi_thread_eigen" not in flags:
+    # On oversubscribed hosts (1-core CI), intra-op Eigen threads
+    # preempt XLA CPU's in-process collective rendezvous and
+    # collective-permute-heavy programs (pp x sp pipelines) abort in
+    # rendezvous.h ("id >= num_threads") — every collective shares
+    # channel_id=1, so the one rendezvous key is reused hundreds of
+    # times per step and the reuse race needs an un-thrashed pool.
+    flags = (flags + " --xla_cpu_multi_thread_eigen=false").strip()
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in flags:
+    # ...and the concurrency-optimized thunk scheduler runs INDEPENDENT
+    # collectives of one program concurrently (e.g. a ring VJP's dq and
+    # dk/dv hop chains) — two in-flight instances of the shared channel
+    # from the same device blow the same rendezvous up. Serialize.
+    flags = (flags
+             + " --xla_cpu_enable_concurrency_optimized_scheduler=false"
+             ).strip()
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 

@@ -107,6 +107,34 @@ def test_supervisor_kills_hung_child(tmp_path):
     assert marker.read_text() == "2"
 
 
+def test_hang_detection_survives_deleted_heartbeat(tmp_path):
+    """Deleting the heartbeat file mid-run must NOT disable hang
+    detection (ADVICE r2: getmtime OSError used to reset staleness to
+    zero forever): the child deletes its own heartbeat then sleeps —
+    the supervisor still kills it, measuring staleness from the last
+    known beat."""
+    marker = tmp_path / "attempts"
+    hb = tmp_path / "hb"
+    cmd = _script(tmp_path, f"""
+        import os, sys, time
+        from pathlib import Path
+        m = Path({str(marker)!r})
+        n = int(m.read_text()) if m.exists() else 0
+        m.write_text(str(n + 1))
+        if n == 0:
+            os.unlink({str(hb)!r})  # vanish the liveness signal...
+            time.sleep(60)          # ...and hang
+        raise SystemExit(0)
+    """) + ["--heartbeat-file", str(hb)]
+    t0 = time.monotonic()
+    sup = Supervisor(cmd, RestartPolicy(max_restarts=2, backoff=0.01),
+                     hang_timeout=15.0, poll_interval=0.2,
+                     log=lambda *_: None)
+    assert sup.run() == 0
+    assert time.monotonic() - t0 < 55
+    assert marker.read_text() == "2"
+
+
 def test_cli_requires_command():
     from shallowspeed_tpu.elastic import main
 

@@ -63,10 +63,13 @@ def test_blocks_sharded_over_pp():
             == blk.sharding)
 
 
-def test_moe_rejected():
-    with pytest.raises(AssertionError, match="dense family"):
+def test_moe_tp_rejected():
+    """MoE composes with dp/pp/sp in this engine — tp is the one axis it
+    does not take."""
+    devs = np.array(jax.devices()[:4]).reshape(1, 2, 2)
+    with pytest.raises(AssertionError, match="MoE x tp"):
         PipelineLMEngine(replace(CFG, n_experts=4), Adam(1e-3),
-                         pp_mesh(1, 4))
+                         Mesh(devs, ("dp", "pp", "tp")))
 
 
 def test_indivisible_layers_rejected():
@@ -238,3 +241,156 @@ def test_pipeline_flash_with_tp_trains():
     losses = [eng.train_batch(tok, tgt) for _ in range(10)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses[::3]
+
+
+# ------------------------------------------- round 3: pp x sp and pp x MoE
+
+
+def pp_sp_mesh(dp, pp, sp):
+    devs = np.array(jax.devices()[: dp * pp * sp]).reshape(dp, pp, sp)
+    return Mesh(devs, ("dp", "pp", "sp"))
+
+
+@pytest.mark.parametrize("dp,pp,sp,n_mu,sched", [
+    (1, 2, 2, 2, "gpipe"), (1, 2, 2, 2, "1f1b"), (2, 2, 2, 1, "gpipe"),
+    (1, 2, 4, 2, "1f1b"),
+])
+def test_pp_sp_matches_plain_dp(dp, pp, sp, n_mu, sched):
+    """Sequence sharding INSIDE pipeline stages (ring attention over
+    'sp', global positions per tile) must reproduce the serial
+    trajectory under both schedules — the composability the round-2
+    verdict flagged as missing (long context and pp were mutually
+    exclusive)."""
+    ref = ref_engine(SGD(0.1))
+    eng = PipelineLMEngine(CFG, SGD(0.1), pp_sp_mesh(dp, pp, sp),
+                           n_mubatches=n_mu, seed=0, schedule=sched,
+                           attn="ring")
+    for step in range(3):
+        tok, tgt = batch(step)
+        lr_ = ref.train_batch(tok, tgt)
+        lp = eng.train_batch(tok, tgt)
+        assert lp == pytest.approx(lr_, rel=3e-4), (step, dp, pp, sp)
+    for a, b in zip(jax.tree_util.tree_leaves(eng.get_canonical_params()),
+                    jax.tree_util.tree_leaves(ref.get_canonical_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_pp_sp_rope_positions_global():
+    """RoPE under sp sharding uses GLOBAL positions: parity vs serial
+    with rope on would fail if each sp tile restarted at position 0."""
+    cfg = replace(CFG, rope=True, norm="rmsnorm")
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    ref = ContextParallelEngine(cfg, SGD(0.1), mesh, seed=0)
+    eng = PipelineLMEngine(cfg, SGD(0.1), pp_sp_mesh(1, 2, 2),
+                           n_mubatches=2, seed=0, attn="ring")
+    for step in range(2):
+        tok, tgt = batch(step)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ref.train_batch(tok, tgt), rel=3e-4), step
+
+
+def test_pp_sp_ring_flash_trains():
+    """The Pallas ring-flash kernel as the stage substrate (interpret
+    mode on CPU) composes with the pipeline: finite + decreasing."""
+    import jax.numpy as _jnp
+
+    cfg = replace(CFG, compute_dtype=_jnp.bfloat16)
+    eng = PipelineLMEngine(cfg, Adam(5e-3), pp_sp_mesh(1, 2, 2),
+                           n_mubatches=2, seed=0, attn="ring-flash")
+    tok, tgt = batch(7)
+    losses = [eng.train_batch(tok, tgt) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses[::2]
+
+
+MOE_CFG = replace(CFG, n_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
+                  n_layers=2)
+
+
+def moe_ref_engine(opt, cfg):
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    return ContextParallelEngine(cfg, opt, mesh, seed=0)
+
+
+@pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+def test_pp_moe_matches_plain_n_mu1(sched):
+    """MoE x pp with ONE microbatch is exactly the non-pipelined MoE
+    step (same routing set, same balance/z aux, every stage's aux
+    collected) — the lifted round-2 assert, both schedules."""
+    ref = moe_ref_engine(SGD(0.1), MOE_CFG)
+    eng = PipelineLMEngine(MOE_CFG, SGD(0.1), pp_mesh(1, 2),
+                           n_mubatches=1, seed=0, schedule=sched)
+    for step in range(3):
+        tok, tgt = batch(step)
+        lr_ = ref.train_batch(tok, tgt)
+        lp = eng.train_batch(tok, tgt)
+        assert lp == pytest.approx(lr_, rel=3e-4), (sched, step)
+    for a, b in zip(jax.tree_util.tree_leaves(eng.get_canonical_params()),
+                    jax.tree_util.tree_leaves(ref.get_canonical_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_pp_moe_microbatched_trains():
+    """n_mu > 1: per-microbatch routing/aux (documented divergence from
+    the full-batch aux — the balance loss is nonlinear in batch
+    composition), so assert training works rather than exact parity."""
+    eng = PipelineLMEngine(MOE_CFG, Adam(5e-3), pp_mesh(2, 2),
+                           n_mubatches=2, seed=0)
+    tok, tgt = batch(5)
+    losses = [eng.train_batch(tok, tgt) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses[::3]
+
+
+def test_pp_moe_sp_composes():
+    """All three: experts in the stage FFN, sequence sharded over 'sp',
+    stages over 'pp'. Oracle: the context engine at the SAME sp tiling
+    (routing is per-tile in both, so n_mu=1 parity is exact)."""
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "sp"))
+    ref = ContextParallelEngine(MOE_CFG, SGD(0.1), mesh, seed=0)
+    eng = PipelineLMEngine(MOE_CFG, SGD(0.1), pp_sp_mesh(1, 2, 2),
+                           n_mubatches=1, seed=0, attn="ring")
+    for step in range(2):
+        tok, tgt = batch(step)
+        assert eng.train_batch(tok, tgt) == pytest.approx(
+            ref.train_batch(tok, tgt), rel=3e-4), step
+
+
+def test_pp_moe_eval_includes_aux():
+    ref = moe_ref_engine(SGD(0.1), MOE_CFG)
+    eng = PipelineLMEngine(MOE_CFG, SGD(0.1), pp_mesh(1, 2),
+                           n_mubatches=1, seed=0)
+    tok, tgt = batch(9)
+    assert eng.eval_loss(tok, tgt) == pytest.approx(
+        ref.eval_loss(tok, tgt), rel=3e-4)
+
+
+def test_pp_chunked_xent_and_remat_policy_match():
+    """cfg.xent_chunk (chunked CE on the last stage, inside the tick
+    scan / 1F1B vjp) and cfg.remat_policy (policied per-stage
+    checkpoint) must not change the pipeline trajectory."""
+    cfgc = replace(CFG, xent_chunk=13, remat=True, remat_policy="dots")
+    for sched in ("gpipe", "1f1b"):
+        ref = ref_engine(SGD(0.1))
+        eng = PipelineLMEngine(cfgc, SGD(0.1), pp_mesh(1, 2),
+                               n_mubatches=2, seed=0, schedule=sched)
+        for step in range(2):
+            tok, tgt = batch(step + 20)
+            assert eng.train_batch(tok, tgt) == pytest.approx(
+                ref.train_batch(tok, tgt), rel=3e-4), (sched, step)
+
+
+def test_pp_sp_chunked_xent_matches():
+    """Chunked CE on sp-sharded last-stage tiles: the per-tile chunk
+    scan + /(n_mu*sp) normalization must equal the plain path."""
+    cfgc = replace(CFG, xent_chunk=16)
+    a = PipelineLMEngine(CFG, SGD(0.1), pp_sp_mesh(1, 2, 2),
+                         n_mubatches=2, seed=0, attn="ring")
+    b = PipelineLMEngine(cfgc, SGD(0.1), pp_sp_mesh(1, 2, 2),
+                         n_mubatches=2, seed=0, attn="ring",
+                         schedule="1f1b")
+    tok, tgt = batch(31)
+    assert a.train_batch(tok, tgt) == pytest.approx(
+        b.train_batch(tok, tgt), rel=3e-4)

@@ -64,6 +64,10 @@ def parse_args(argv=None):
                         "the elastic supervisor, shallowspeed_tpu.elastic)")
     p.add_argument("--profile-dir", type=str, default="",
                    help="write a jax.profiler trace of the training epochs")
+    p.add_argument("--heartbeat-file", type=str, default="",
+                   help="touch this file at every epoch log point — the "
+                        "elastic supervisor's liveness signal "
+                        "(shallowspeed_tpu/elastic.py hang detection)")
     p.add_argument("--log-file", type=str, default="",
                    help="append per-epoch JSONL metrics here")
     p.add_argument("--platform", type=str, default=None,
@@ -241,6 +245,8 @@ def train(args) -> float:
             accuracy = compute_accuracy(engine, val_ds)
             rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
                    f"Accuracy: {accuracy * 100:.2f}%")
+            if args.heartbeat_file:
+                Path(args.heartbeat_file).touch()
             t_epoch = time.time()
             if staged is not None:
                 engine.train_epoch(staged)

@@ -115,6 +115,21 @@ def parse_args(argv=None):
                    help="rematerialize each block's activations in the "
                         "backward (jax.checkpoint): ~1 extra forward of "
                         "FLOPs for O(layers)->O(1) activation memory")
+    p.add_argument("--remat-policy", default="full",
+                   choices=["full", "attn", "dots"],
+                   help="what --remat SAVES per block: full = nothing "
+                        "(max saving, +1 fwd of recompute), attn = the "
+                        "attention output (never re-runs the attention "
+                        "substrate), dots = every matmul output "
+                        "(elementwise-only recompute; use when "
+                        "microbatched activations fit)")
+    p.add_argument("--xent-chunk", type=int, default=0,
+                   help="chunked cross-entropy: compute the loss over "
+                        "this many positions at a time (logits remat'd "
+                        "per chunk) — never materializes the (B*T, vocab) "
+                        "logits; 0 = whole-batch log-softmax")
+    p.add_argument("--d-ff", type=int, default=0,
+                   help="FFN hidden width (0 = 4*d_model)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3/FSDP: shard params, grads, AND optimizer "
                         "state over the dp axis (XLA derives the "
@@ -313,10 +328,21 @@ def train(args) -> float:
                          f"token prompt exceeds --seq-len {args.seq_len} "
                          f"(= max_seq)")
     composite = args.sp > 1 and args.tp > 1
-    if args.pp > 1 and (args.sp > 1 or args.ep > 1 or args.experts
-                        or args.fsdp or args.zero1 or args.zero2):
-        raise SystemExit("--pp composes with --dp and --tp only for now")
-    if args.pp > 1 and args.attn not in ("ring", "flash"):
+    if args.pp > 1 and (args.ep > 1 or args.fsdp or args.zero1
+                        or args.zero2):
+        raise SystemExit("--pp composes with --dp, --tp, --sp, and "
+                         "--experts (not --ep/--fsdp/--zero*)")
+    if args.pp > 1 and args.sp > 1 and args.tp > 1:
+        raise SystemExit("--pp takes ONE extra model axis: --tp or --sp")
+    if args.pp > 1 and args.experts and args.tp > 1:
+        raise SystemExit("--experts with --pp composes with --dp/--sp "
+                         "(not --tp)")
+    if args.pp > 1 and args.sp > 1 and args.attn not in (
+            "ring", "ring-flash", "ulysses-flash"):
+        raise SystemExit(f"--pp with --sp needs a sequence-parallel "
+                         f"attention substrate (--attn ring, ring-flash "
+                         f"or ulysses-flash), got {args.attn}")
+    if args.pp > 1 and args.sp == 1 and args.attn not in ("ring", "flash"):
         raise SystemExit(f"--attn {args.attn} is not available with --pp "
                          "(XLA attention by default, or the fused Pallas "
                          "kernel via --attn flash)")
@@ -355,13 +381,13 @@ def train(args) -> float:
     if args.experts and args.moe_top_k > args.experts:
         raise SystemExit(f"--moe-top-k {args.moe_top_k} cannot exceed "
                          f"--experts {args.experts}")
-    if args.experts and args.attn != "ring":
+    if args.experts and args.pp <= 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with "
                          "--experts (the MoE engine uses XLA attention)")
     if composite:
         model_par = args.sp * args.tp
     elif args.pp > 1:
-        model_par = args.pp * args.tp
+        model_par = args.pp * args.tp * args.sp
     elif (args.ep > 1 or args.experts) and args.sp > 1:
         model_par = args.sp * args.ep  # long-context MoE: (dp, sp, ep)
     else:
@@ -383,7 +409,10 @@ def train(args) -> float:
                             moe_top_k=args.moe_top_k,
                             moe_z_weight=args.moe_z_weight,
                             compute_dtype=jnp.bfloat16 if args.bf16 else None,
-                            remat=args.remat, rope=args.rope,
+                            remat=args.remat,
+                            remat_policy=args.remat_policy,
+                            xent_chunk=args.xent_chunk, d_ff=args.d_ff,
+                            rope=args.rope,
                             norm=args.norm, ffn=args.ffn,
                             n_kv_heads=args.kv_heads,
                             dropout=args.dropout,
@@ -410,14 +439,19 @@ def train(args) -> float:
         if args.tp > 1:
             mesh = Mesh(devs.reshape(args.dp, args.pp, args.tp),
                         ("dp", "pp", "tp"))
+            pp_attn = "flash" if args.attn == "flash" else "xla"
+        elif args.sp > 1:
+            mesh = Mesh(devs.reshape(args.dp, args.pp, args.sp),
+                        ("dp", "pp", "sp"))
+            pp_attn = args.attn  # ring / ring-flash / ulysses-flash
         else:
             mesh = Mesh(devs.reshape(args.dp, args.pp), ("dp", "pp"))
+            pp_attn = "flash" if args.attn == "flash" else "xla"
         engine = PipelineLMEngine(cfg, opt, mesh,
                                   n_mubatches=args.n_mubatches,
                                   seed=args.seed,
                                   schedule=args.pp_schedule,
-                                  attn="flash" if args.attn == "flash"
-                                  else "xla")
+                                  attn=pp_attn)
     elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
