@@ -168,14 +168,38 @@ def bench_transformer_mfu():
 
     r = mfu_run(argparse.Namespace(
         vocab=256, d_model=2048, n_heads=16, n_layers=4, seq_len=2048,
-        batch_size=8, ffn="swiglu", attn="flash", steps=10, remat=False))
-    return {
+        batch_size=8, ffn="swiglu", attn="flash", steps=10, remat=False,
+        remat_policy="full", xent_chunk=0, accum=1, optimizer="adamw"))
+    out = {
         "transformer_tokens_per_sec": r["tokens_per_sec"],
         "transformer_tflops": r["tflops"],
         "transformer_peak_tflops": r["peak_tflops"],
         "transformer_mfu": r["mfu"],
         "transformer_config": r["config"],
     }
+    import os
+
+    if os.environ.get("BENCH_SKIP_BIG"):
+        return out
+    try:
+        # the big-model bar (VERDICT r2 item 1): 1.21B params, vocab 32k,
+        # f32 master weights, on ONE 16GB chip — Adafactor + bf16 +
+        # dots-policy remat + chunked cross-entropy. Round 2 ran this at
+        # 36.4% MFU; the round-3 recipe measures ~60%.
+        rb = mfu_run(argparse.Namespace(
+            vocab=32768, d_model=2048, n_heads=16, n_layers=16,
+            seq_len=2048, batch_size=4, ffn="swiglu", attn="flash",
+            steps=6, remat=True, remat_policy="dots", xent_chunk=1024,
+            accum=1, optimizer="adafactor"))
+        out.update({
+            "big_model_mfu": rb["mfu"],
+            "big_model_tflops": rb["tflops"],
+            "big_model_tokens_per_sec": rb["tokens_per_sec"],
+            "big_model_params_m": rb["config"]["params_m"],
+        })
+    except Exception as e:  # pragma: no cover - keep the headline robust
+        out["big_model_error"] = repr(e)[:200]
+    return out
 
 
 def pinned_baseline() -> float | None:

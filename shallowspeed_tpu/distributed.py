@@ -140,20 +140,34 @@ def hybrid_mesh(axis_names: tuple[str, ...], axis_sizes: tuple[int, ...],
     return Mesh(grid, axis_names)
 
 
-def place_global(arr: np.ndarray, sharding: NamedSharding) -> jax.Array:
-    """Assemble a globally-sharded jax.Array from this process's LOCAL data.
+def place_global(arr: np.ndarray, sharding: NamedSharding,
+                 local: bool = True) -> jax.Array:
+    """Assemble a globally-sharded jax.Array across processes.
 
     Single-process: plain `device_put` (arr is the global array).
-    Multi-process: `arr` is this host's shard of the global batch — e.g.
-    with the global batch sharded over 'dp' and P processes, each process
-    passes its B/P rows — and the pieces are stitched into one global
-    array without any host ever holding the whole thing. This is how the
-    reference's per-rank `Dataset.load(DP_rank, DP_size)` strided shards
-    (`dataset.py:54-58`) map to single-controller-per-host JAX.
+    Multi-process, `local=True` (default): `arr` is this host's shard of
+    the global batch — e.g. with the global batch sharded over 'dp' and
+    P processes, each process passes its B/P rows — and the pieces are
+    stitched into one global array without any host ever holding the
+    whole thing. This is how the reference's per-rank
+    `Dataset.load(DP_rank, DP_size)` strided shards (`dataset.py:54-58`)
+    map to single-controller-per-host JAX.
+
+    Multi-process, `local=False`: every process holds the SAME full
+    global array (deterministically built batches); each device pulls
+    its slice via `make_array_from_callback`. Callers that replicate
+    batch construction (the pipeline engine's microbatch splitter) MUST
+    use this form — `make_array_from_process_local_data` would silently
+    misread a full-global array as the process-local block whenever a
+    sharded dimension spans processes.
     """
     if isinstance(arr, jax.Array) or jax.process_count() == 1:
         # already placed (no-op/reshard) or single-process global array
         return jax.device_put(arr, sharding)
+    if not local:
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
     return jax.make_array_from_process_local_data(sharding, arr)
 
 

@@ -749,12 +749,16 @@ class PipelineLMEngine:
         mubs = b // (self.dp * self.n_mu)
         spec = (P(None, "dp", "sp") if self.has_sp else P(None, "dp"))
         # (B, T) -> (n_mu, dp*mubs, T): microbatch-major so each dp shard
-        # of axis 1 holds rows of every microbatch
-        return jax.device_put(
+        # of axis 1 holds rows of every microbatch. place_global (not a
+        # bare device_put) so multi-controller runs stitch each process's
+        # host-local piece into the global batch (distributed.py).
+        from shallowspeed_tpu.distributed import place_global
+
+        return place_global(
             np.ascontiguousarray(
                 arr.reshape(self.dp, self.n_mu, mubs, t)
                 .transpose(1, 0, 2, 3).reshape(self.n_mu, -1, t)),
-            NamedSharding(self.mesh, spec))
+            NamedSharding(self.mesh, spec), local=False)
 
     def place(self, arr) -> jax.Array:
         if isinstance(arr, jax.Array):

@@ -28,6 +28,7 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 def main() -> None:
     pid, port = int(sys.argv[1]), sys.argv[2]
+    mode = sys.argv[3] if len(sys.argv) > 3 else "dp"
     sys.path.insert(0, str(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))))
 
@@ -45,6 +46,10 @@ def main() -> None:
     assert jax.device_count() == 4, jax.device_count()  # 2 local x 2 procs
     assert len(jax.local_devices()) == 2
     assert process_zero() == (pid == 0)
+
+    if mode in ("pp", "ppsp"):
+        _pipeline_mode(pid, mode)
+        return
 
     # dp=4 spans BOTH processes: the gradient pmean/psum crosses the
     # process boundary; place_global stitches each process's local row
@@ -68,6 +73,69 @@ def main() -> None:
 
     w = np.asarray(jax.device_get(eng.params["tok_emb"]))
     print(f"HASH {pid} {hashlib.sha1(w.tobytes()).hexdigest()}", flush=True)
+    barrier("done")
+    print(f"DONE {pid}", flush=True)
+
+
+def _pipeline_mode(pid: int, mode: str) -> None:
+    """Pipeline / context parallelism ACROSS the OS-process boundary —
+    the analogue of the reference's inter-rank blocking Send/Recv
+    (`/root/reference/shallowspeed/pipe.py:367-381`), which round 2's
+    dp-only 2-process run never exercised:
+
+    - mode "pp": a ('dp','pp') mesh with the PP axis spanning the two
+      processes — every inter-stage `ppermute` activation/cotangent hop
+      crosses the boundary, under BOTH compiled schedules.
+    - mode "ppsp": a ('dp','pp','sp') mesh with the SP axis spanning
+      the processes — the ring-attention K/V rotation crosses the
+      boundary every layer.
+
+    jax.devices() orders devices process-major ([p0d0, p0d1, p1d0,
+    p1d1]); transposing puts the chosen axis across processes. Batches
+    route through `place_global` (PipelineLMEngine._split_mu), so the
+    multi-controller data path runs for real here too."""
+    import hashlib
+
+    import numpy as np
+
+    from shallowspeed_tpu.distributed import barrier
+    from shallowspeed_tpu.models.transformer import TransformerConfig
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+    from jax.sharding import Mesh
+
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=2,
+                            max_seq=16)
+    by_proc = np.array(jax.devices()).reshape(2, 2)  # [process, local]
+    if mode == "pp":
+        # dp = local device index, pp = process index -> pp hops cross
+        mesh = Mesh(by_proc.T, ("dp", "pp"))
+        engines = [
+            ("gpipe", PipelineLMEngine(cfg, SGD(0.1), mesh,
+                                       n_mubatches=2, seed=0,
+                                       schedule="gpipe")),
+            ("1f1b", PipelineLMEngine(cfg, SGD(0.1), mesh,
+                                      n_mubatches=2, seed=0,
+                                      schedule="1f1b")),
+        ]
+    else:  # ppsp: sp = process index -> ring K/V hops cross
+        mesh = Mesh(by_proc.T[None], ("dp", "pp", "sp"))
+        engines = [
+            ("gpipe", PipelineLMEngine(cfg, SGD(0.1), mesh,
+                                       n_mubatches=2, seed=0,
+                                       schedule="gpipe", attn="ring")),
+        ]
+
+    for tag, eng in engines:
+        for step in range(3):
+            rng = np.random.default_rng([11, step])  # same on every proc
+            tok = rng.integers(0, cfg.vocab, (8, 16)).astype(np.int32)
+            tgt = np.roll(tok, -1, axis=1).astype(np.int32)
+            loss = eng.train_batch(tok, tgt)
+            print(f"LOSS {pid} {tag}:{step} {loss!r}", flush=True)
+        w = np.asarray(jax.device_get(eng.params["tok_emb"]))
+        print(f"HASH {pid} {tag}:{hashlib.sha1(w.tobytes()).hexdigest()}",
+              flush=True)
     barrier("done")
     print(f"DONE {pid}", flush=True)
 
