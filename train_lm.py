@@ -420,6 +420,19 @@ def train(args) -> float:
                             label_smoothing=args.label_smoothing,
                             logit_softcap=args.logit_softcap,
                             attn_window=args.attn_window)
+    if jax.default_backend() == "tpu" and 256 < args.d_model <= 1024:
+        # measured on this v5e (scripts/bench_matmul.py, BASELINE.md):
+        # ops with K and N both <= 1024 run far below MXU peak (fixed
+        # per-pass costs dominate), so d_model <= 1024 configs cap out
+        # around 26-35% MFU while d_model >= 2048 reaches ~57%. Tiny
+        # (demo-sized, <=256) models are exempt — nobody MFU-tunes those.
+        from shallowspeed_tpu.utils import rprint as _rprint
+
+        _rprint(f"note: d_model={args.d_model} puts the attention/FFN "
+                f"projections in the MXU's starved small-matmul regime "
+                f"on this chip (~26-35% MFU vs ~57% at d_model>=2048); "
+                f"prefer fewer/wider layers or raise batch*seq "
+                f"(BASELINE.md 'narrow-matmul' section)")
     from shallowspeed_tpu.optim import SCHEDULES
 
     if args.lr_schedule == "constant":
