@@ -36,7 +36,7 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
-def bench_engine(schedule, args):
+def bench_engine(schedule, args, virtual_pp=1):
     from jax.sharding import Mesh
 
     from shallowspeed_tpu.models.transformer import TransformerConfig
@@ -52,7 +52,8 @@ def bench_engine(schedule, args):
     mesh = Mesh(devs, ("dp", "pp"))
     eng = PipelineLMEngine(cfg, AdamW(3e-4), mesh,
                            n_mubatches=args.n_mu, seed=0,
-                           schedule=schedule, attn="flash")
+                           schedule=schedule, attn="flash",
+                           virtual_pp=virtual_pp)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, cfg.vocab,
                         (args.batch_size, args.seq_len)).astype(np.int32)
@@ -80,11 +81,14 @@ def main():
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--virtual-pp", type=int, default=2,
+                    help="also benchmark interleaved virtual stages at "
+                         "this chunk count (0/1 = skip)")
     args = ap.parse_args()
 
     gpipe = bench_engine("gpipe", args)
     f1b1 = bench_engine("1f1b", args)
-    print(json.dumps({
+    out = {
         "metric": "pipeline_schedule_throughput",
         "substrate": f"cpu-{args.pp}dev-virtual",
         "config": {"pp": args.pp, "n_mubatches": args.n_mu,
@@ -93,7 +97,12 @@ def main():
         "gpipe_tokens_per_sec": round(gpipe, 0),
         "1f1b_tokens_per_sec": round(f1b1, 0),
         "1f1b_over_gpipe": round(f1b1 / gpipe, 3),
-    }))
+    }
+    if args.virtual_pp > 1 and args.n_layers % (args.pp * args.virtual_pp) == 0:
+        inter = bench_engine("gpipe", args, virtual_pp=args.virtual_pp)
+        out["interleaved_tokens_per_sec"] = round(inter, 0)
+        out["interleaved_over_gpipe"] = round(inter / gpipe, 3)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -98,6 +98,11 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 1e-2
+    # MoE slot-assignment order: "sequence" (GShard: earlier tokens claim
+    # an overflowing expert's slots) or "priority" (V-MoE batch-priority:
+    # highest-gate assignments claim slots — drops hit the router's
+    # least-confident choices instead of late-sequence tokens).
+    moe_routing: str = "sequence"
     # Router z-loss weight (ST-MoE): penalizes router-logit magnitude —
     # the standard stabilizer for long MoE runs. 0 = off (default, so
     # existing trajectories are bit-unchanged); 1e-3 is the usual value.
@@ -151,6 +156,8 @@ class TransformerConfig:
     def __post_init__(self):
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
         assert self.ffn in ("gelu", "swiglu"), self.ffn
+        assert self.moe_routing in ("sequence", "priority"), \
+            self.moe_routing
         assert self.remat_policy in ("full", "attn", "dots"), \
             self.remat_policy
         assert self.xent_chunk >= 0, self.xent_chunk
@@ -445,7 +452,8 @@ def _ffn(p, x, cfg: TransformerConfig, h, key=None):
     coupling between the two)."""
     if "moe" in p:
         y, aux, z, st = moe_ffn(p["moe"], h, cfg.moe_top_k,
-                                cfg.moe_capacity_factor)
+                                cfg.moe_capacity_factor,
+                                priority=cfg.moe_routing == "priority")
         return x + _dropout(y, cfg.dropout, key), (aux, z, st)
     if "gate" in p:  # SwiGLU: silu(gate) * up, both column-parallel
         u = jax.nn.silu(_dense(p["gate"], h)) * _dense(p["up"], h)

@@ -37,10 +37,20 @@ def expert_capacity(seq_len: int, num_experts: int, top_k: int,
 
 
 def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
-                          top_k: int = 2):
+                          top_k: int = 2, priority: bool = False):
     """GShard-style top-k routing with per-expert capacity.
 
     gate_logits: (G, S, E) — G batch groups of S tokens over E experts.
+
+    `priority=True` switches slot assignment from sequence order to
+    BATCH-PRIORITY routing (Riquelme et al., V-MoE): within each k,
+    tokens claim an expert's slots in descending gate-weight order, so
+    when an expert overflows it drops its LOWEST-confidence assignments
+    instead of whatever came late in the sequence. The drop *count* at
+    fixed capacity is unchanged (overflow is overflow) — what improves
+    is which mass survives: the kept fraction of total gate weight
+    rises, and with it loss at aggressive capacity factors. Positional
+    bias goes away too (sequence order stops mattering).
 
     Returns:
       combine:  (G, S, E, C) float32 — combine[g, s, e, c] is token (g, s)'s
@@ -68,8 +78,8 @@ def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
 
     # Top-k expert choices per token, gates renormalized over the chosen k.
-    topk_gate, topk_idx = jax.lax.top_k(probs, top_k)          # (G, S, K)
-    topk_gate = topk_gate / (topk_gate.sum(-1, keepdims=True) + 1e-9)
+    raw_gate, topk_idx = jax.lax.top_k(probs, top_k)           # (G, S, K)
+    topk_gate = raw_gate / (raw_gate.sum(-1, keepdims=True) + 1e-9)
 
     combine = jnp.zeros((g, s, e, capacity), jnp.float32)
     used = jnp.zeros((g, e), jnp.float32)  # slots consumed by earlier k
@@ -77,9 +87,21 @@ def topk_capacity_routing(gate_logits: jax.Array, capacity: int,
     assigned = jnp.zeros((e,), jnp.float32)  # pre-drop per-expert counts
     for k in range(top_k):
         onehot = jax.nn.one_hot(topk_idx[..., k], e)            # (G, S, E)
-        # Position of each token within its expert's buffer: tokens assigned
-        # earlier in the sequence (or by an earlier k) occupy lower slots.
-        pos = jnp.cumsum(onehot, axis=1) - onehot + used[:, None, :]
+        if priority:
+            # Batch-priority: rank this k's assignments per expert by
+            # the RAW router probability (descending; stable, so
+            # sequence order breaks ties) — the renormalized gate would
+            # degenerate to 1.0 at top_k=1. Unassigned tokens score 0
+            # and sort after every positive-gate assignment, so ranks
+            # below `capacity` are exactly the top-gated claimants.
+            score = onehot * raw_gate[..., k, None]             # (G, S, E)
+            order = jnp.argsort(-score, axis=1)                 # (G, S, E)
+            rank = jnp.argsort(order, axis=1).astype(jnp.float32)
+            pos = rank + used[:, None, :]
+        else:
+            # Sequence order: tokens assigned earlier in the sequence
+            # (or by an earlier k) occupy lower slots (GShard).
+            pos = jnp.cumsum(onehot, axis=1) - onehot + used[:, None, :]
         keep = onehot * (pos < capacity)                        # (G, S, E)
         slot = jax.nn.one_hot((pos * onehot).sum(-1).astype(jnp.int32),
                               capacity)                         # (G, S, C)
@@ -108,7 +130,8 @@ def router_z_loss(gate_logits: jax.Array) -> jax.Array:
     return jnp.mean(z * z)
 
 
-def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
+def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float,
+            priority: bool = False):
     """Mixture-of-experts feed-forward layer (drop-in for the dense GELU MLP).
 
     p: {"gate": (d, E), "wi": (E, d, ff), "bi": (E, ff),
@@ -132,8 +155,8 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float):
     # topk_capacity_routing is f32 already).
     logits = jnp.einsum("gsd,de->gse", x, p["gate"],
                         preferred_element_type=jnp.float32)     # (G, S, E)
-    combine, dispatch, aux, stats = topk_capacity_routing(logits, cap,
-                                                          top_k)
+    combine, dispatch, aux, stats = topk_capacity_routing(
+        logits, cap, top_k, priority=priority)
 
     xin = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)
     h = jax.nn.gelu(jnp.einsum("egcd,edf->egcf", xin, p["wi"])

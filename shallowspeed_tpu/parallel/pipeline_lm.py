@@ -143,12 +143,14 @@ class PipelineLMEngine:
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  n_mubatches: int = 4, seed: int = 0,
-                 schedule: str = "gpipe", attn: str = "xla"):
+                 schedule: str = "gpipe", attn: str = "xla",
+                 virtual_pp: int = 1):
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp"),
                                    ("dp", "pp", "sp")), (
             f"PipelineLMEngine expects a ('dp','pp'[,'tp'|'sp']) mesh, "
             f"got {mesh.axis_names}")
         assert schedule in ("gpipe", "1f1b"), schedule
+        assert virtual_pp >= 1, virtual_pp
         assert attn in ("xla", "flash", "ring", "ring-flash",
                         "ulysses-flash"), attn
         self.schedule = schedule
@@ -176,6 +178,20 @@ class PipelineLMEngine:
             "MoE x tp is not supported in the pipeline engine (MoE "
             "composes with dp/pp/sp here, and with dp/ep in "
             "parallel/expert.py)")
+        self.vpp = virtual_pp
+        if virtual_pp > 1:
+            # interleaved virtual stages: device d hosts logical stages
+            # {d, d+pp, ...}; the chunk hops are a plain ring, so only
+            # schedules/axes without intra-chunk collectives compose
+            assert schedule == "gpipe", (
+                "virtual_pp composes with the autodiff GPipe schedule "
+                "(the hand-built 1F1B slot algebra is per-physical-stage)")
+            assert not self.has_tp and self.sp == 1, (
+                "virtual_pp needs collective-free chunk bodies "
+                "(no tp psum / sp ring inside a cond-gated chunk)")
+            assert cfg.n_layers % (self.pp * virtual_pp) == 0, (
+                f"n_layers={cfg.n_layers} must divide over "
+                f"pp*virtual_pp={self.pp * virtual_pp}")
         assert cfg.n_layers % self.pp == 0, (
             f"n_layers={cfg.n_layers} must be divisible by pp={self.pp}")
         assert cfg.n_heads % self.tp == 0, (
@@ -191,7 +207,21 @@ class PipelineLMEngine:
 
         self.rep = NamedSharding(mesh, P())
         self.row = NamedSharding(mesh, P("dp"))
+        # interleaved placement permutation: stacked position
+        # d*(vpp*Lc) + v*Lc + j holds layer (v*pp + d)*Lc + j, so the
+        # P('pp') shard of device d is exactly its vpp chunks in order.
+        # Identity when vpp == 1.
+        lc = cfg.n_layers // (self.pp * self.vpp)
+        self._perm = np.array([
+            (v * self.pp + d) * lc + j
+            for d in range(self.pp)
+            for v in range(self.vpp)
+            for j in range(lc)])
+        self._inv_perm = np.argsort(self._perm)
         host = stack_blocks(T.init(cfg, seed))
+        if self.vpp > 1:
+            host = {**host, "blocks": tree_map(
+                lambda l: l[self._perm], host["blocks"])}
         # stacked blocks shard their layer axis over pp; with a tp axis the
         # feature dims additionally take the Megatron placement (qkv/up
         # column-sharded — whole head groups, thanks to the head-major
@@ -337,8 +367,10 @@ class PipelineLMEngine:
             if cfg.n_experts > 0:
                 from shallowspeed_tpu.ops.moe import moe_ffn
 
-                y, bal, z, _ = moe_ffn(blk["moe"], h, cfg.moe_top_k,
-                                       cfg.moe_capacity_factor)
+                y, bal, z, _ = moe_ffn(
+                    blk["moe"], h, cfg.moe_top_k,
+                    cfg.moe_capacity_factor,
+                    priority=cfg.moe_routing == "priority")
                 aux = (cfg.moe_aux_weight * bal
                        + cfg.moe_z_weight * z).astype(jnp.float32)
                 return x + T._dropout(y, cfg.dropout, k_ffn), aux
@@ -384,7 +416,8 @@ class PipelineLMEngine:
 
             if cfg.remat:
                 body = jax.checkpoint(body, policy=T._remat_policy(cfg))
-            keys = jax.random.split(key, self.l_local)
+            n_blk = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+            keys = jax.random.split(key, n_blk)
             (x, aux), _ = jax.lax.scan(
                 body, (x, aux0), (blocks, keys))
             return x, aux
@@ -470,7 +503,121 @@ class PipelineLMEngine:
             # under the caller's psum — mean of equal-sized tiles is exact
             return loss_sum / (n_mu * sp), None
 
+        vpp = self.vpp
+        lcv = cfg.n_layers // (pp * vpp)
+
+        def local_loss_virtual(params, tokens, targets, key=None,
+                               train=True):
+            """Interleaved virtual-stage GPipe (inside shard_map):
+            device d runs chunk v as LOGICAL stage v*pp + d; the tick
+            hop ppermutes the whole (vpp, ...) chunk buffer around the
+            pp ring, and on device 0 the arriving messages shift up one
+            chunk (the wrap from the last device feeds the NEXT chunk).
+            Chunk compute is cond-gated — bubble ticks cost only the
+            hop — which is safe because chunk bodies carry no
+            collectives (tp/sp are asserted off for virtual_pp > 1).
+            Ticks: n_mu + pp*vpp - 1, each 1/vpp the work of a plain
+            GPipe tick — the interleaving bubble shrink
+            (`verify.simulate_interleaved` proves the schedule-level
+            version). Backward = autodiff of this scan, like GPipe."""
+            s = jax.lax.axis_index("pp")
+            depth = pp * vpp
+            params = T.cast_params(params, cfg.compute_dtype)
+            mubs, t = tokens.shape[1], tokens.shape[2]
+            pos = jnp.arange(t)
+            dt = cfg.compute_dtype or cfg.dtype
+
+            def chunk_blocks(v):
+                return tree_map(lambda l: l[v * lcv:(v + 1) * lcv],
+                                params["blocks"])
+
+            def tick(carry, tk):
+                cur, loss_acc = carry      # cur: (vpp, mubs, t, d)
+                outs = []
+                for v in range(vpp):       # static unroll over chunks
+                    logical = v * pp + s
+                    m = jnp.clip(tk - logical, 0, n_mu - 1)
+                    active = (tk - logical >= 0) & (tk - logical < n_mu)
+                    tok_m = jax.lax.dynamic_index_in_dim(
+                        tokens, m, 0, False)
+                    tgt_m = jax.lax.dynamic_index_in_dim(
+                        targets, m, 0, False)
+                    k_stage, k_emb = mu_key(key, m)
+                    if k_stage is not None:  # decorrelate chunks
+                        k_stage = jax.random.fold_in(k_stage, v)
+                    x_own = params["tok_emb"][tok_m]
+                    if not cfg.rope:
+                        x_own = x_own + params["pos_emb"][pos]
+                    if cfg.compute_dtype is not None:
+                        x_own = x_own.astype(cfg.compute_dtype)
+                    x_own = T._dropout(x_own, cfg.dropout, k_emb)
+                    x_in = jnp.where(logical == 0, x_own, cur[v])
+
+                    def work(x_in, v=v):
+                        h, aux = apply_blocks(chunk_blocks(v), x_in,
+                                              pos, k_stage)
+                        # zero derived from x_in so contrib carries the
+                        # (pp, dp)-varying type in EVERY chunk (dense
+                        # chunks' aux is an invariant 0.0, which would
+                        # type-clash with skip's pvaried zero)
+                        contrib = (x_in[0, 0, 0] * 0).astype(
+                            jnp.float32) + aux
+                        if v == vpp - 1:  # the depth-1 logical stage
+                            hf = T._norm(params["ln_f"], h, cfg)
+                            nll = head_nll(params, hf, tgt_m, train)
+                            contrib = contrib + jnp.where(
+                                s == pp - 1, nll, 0.0)
+                        return h, contrib
+
+                    def skip(x_in):
+                        return _pvary(
+                            (jnp.zeros((mubs, t, cfg.d_model), dt),
+                             jnp.float32(0.0)), ("pp", "dp"))
+
+                    h_v, contrib = jax.lax.cond(active, work, skip,
+                                                x_in)
+                    loss_acc = loss_acc + jnp.where(active, contrib,
+                                                    0.0)
+                    outs.append(h_v)
+                hopped = jax.lax.ppermute(jnp.stack(outs), "pp", right)
+                # device 0's arrivals come from the ring wrap: chunk
+                # v's output becomes chunk v+1's input (slot 0 is
+                # re-embedded anyway)
+                cur_next = jnp.where(s == 0,
+                                     jnp.roll(hopped, 1, axis=0), hopped)
+                return (cur_next, loss_acc), None
+
+            init = _pvary(
+                (jnp.zeros((vpp, mubs, t, cfg.d_model), dt),
+                 jnp.float32(0.0)), ("pp", "dp"))
+            (_, loss_sum), _ = jax.lax.scan(
+                tick, init, jnp.arange(n_mu + depth - 1))
+            return loss_sum / n_mu, None
+
+        loss_fn = local_loss_virtual if vpp > 1 else local_loss
+
         def grads_and_loss(params, tokens, targets, key):
+            if vpp > 1:
+                # pvary params BEFORE differentiating: the virtual path
+                # cond-gates chunk compute on a pp-varying predicate,
+                # and variance-typed autodiff would otherwise insert
+                # the invariant-param cotangent psum INSIDE the branch
+                # — devices in different branches then execute different
+                # collective sequences and the rendezvous deadlocks
+                # (same hazard the 1F1B path documents). Varying params
+                # keep cotangents local; the reduction happens once,
+                # here (grad_psum_axes is the 1F1B section's per-leaf
+                # axis list — identical contract).
+                (loss, _), grads = jax.value_and_grad(
+                    lambda p: local_loss_virtual(p, tokens, targets,
+                                                 key),
+                    has_aux=True)(_pvary(params, ("dp", "pp")))
+                g_leaves, tdef = jax.tree_util.tree_flatten(grads)
+                g_leaves = [jax.lax.psum(g, ax) if ax else g
+                            for g, ax in zip(g_leaves, grad_psum_axes)]
+                grads = jax.tree_util.tree_unflatten(tdef, g_leaves)
+                loss = jax.lax.psum(loss, "pp")
+                return jax.lax.pmean(loss, "dp"), grads
             (loss, _), grads = jax.value_and_grad(
                 local_loss, has_aux=True)(params, tokens, targets, key)
             # variance typing does the reductions: block grads arrive
@@ -728,7 +875,7 @@ class PipelineLMEngine:
         @partial(shard_map, mesh=self.mesh,
                  in_specs=(pspecs, dspec, dspec), out_specs=P())
         def _eval(params, tokens, targets):
-            loss, _ = local_loss(params, tokens, targets, train=False)
+            loss, _ = loss_fn(params, tokens, targets, train=False)
             loss = jax.lax.psum(loss,
                                 ("pp", "sp") if self.has_sp else "pp")
             return jax.lax.pmean(loss, "dp")
@@ -959,22 +1106,35 @@ class PipelineLMEngine:
 
     # -------------------------------------------- checkpoint interface
 
+    def _unpermute(self, tree):
+        if self.vpp == 1:
+            return tree
+        return {**tree, "blocks": tree_map(
+            lambda l: l[self._inv_perm], tree["blocks"])}
+
+    def _permute(self, tree):
+        if self.vpp == 1:
+            return tree
+        return {**tree, "blocks": tree_map(
+            lambda l: l[self._perm], tree["blocks"])}
+
     def canon_export_tree(self, tree):
         """Params-shaped tree (e.g. Adam moments) -> canonical layout;
         the SAME transform params take into a checkpoint."""
-        return unstack_blocks(jax.device_get(tree), self.cfg.n_layers)
+        return unstack_blocks(self._unpermute(jax.device_get(tree)),
+                              self.cfg.n_layers)
 
     def canon_import_tree(self, tree):
         """Inverse of `canon_export_tree` (host-side; placement happens
         in `set_opt_state`)."""
-        return stack_blocks(tree_map(np.asarray, tree))
+        return self._permute(stack_blocks(tree_map(np.asarray, tree)))
 
     def get_canonical_params(self):
-        return unstack_blocks(jax.device_get(self.params),
+        return unstack_blocks(self._unpermute(jax.device_get(self.params)),
                               self.cfg.n_layers)
 
     def set_canonical_params(self, params):
-        host = stack_blocks(tree_map(np.asarray, params))
+        host = self._permute(stack_blocks(tree_map(np.asarray, params)))
         self.params = jax.device_put(
             host, tree_map(lambda s: NamedSharding(self.mesh, s),
                            self._pspecs,

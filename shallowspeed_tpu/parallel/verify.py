@@ -218,3 +218,112 @@ def simulate(schedule_cls, num_micro_batches: int, num_stages: int,
 # public-API alias (`shallowspeed_tpu.simulate_schedule`): the package
 # namespace needs a name that says what is simulated
 simulate_schedule = simulate
+
+
+# ------------------------------------------- interleaved 1F1B (virtual)
+
+
+@dataclass
+class InterleavedReport:
+    """Device-level simulation result for interleaved 1F1B."""
+
+    makespan: int            # chunk-unit rounds (one chunk = 1 unit)
+    plain_makespan: int      # plain 1F1B at depth pp, scaled to chunk units
+    peak_stash: list         # per-DEVICE peak in-flight forward stashes
+    logical: SimReport       # full channel-semantics proof at depth pp*vpp
+
+
+def simulate_interleaved(num_micro_batches: int, pp: int,
+                         vpp: int) -> InterleavedReport:
+    """Interleaved (virtual-stage) 1F1B — Megatron-style: device d hosts
+    logical stages {d, d+pp, ..., d+(vpp-1)pp}, each running the plain
+    1F1B instruction stream at logical depth pp*vpp.
+
+    Two-level proof:
+    - the LOGICAL pipeline is verified with full channel semantics by
+      `simulate` (deadlock-freedom, tag-matched dataflow, per-logical-
+      stage stash bound) — interleaving changes device placement, not
+      the streams;
+    - this function then list-schedules those verified streams under
+      DEVICE contention (each device executes at most one chunk-compute
+      per round; drain-first priority: a ready backward beats a ready
+      forward, matching 1F1B's memory discipline) and measures the real
+      makespan in chunk units plus each device's aggregate stash peak.
+
+    The interleaving win: plain 1F1B's bubble is (pp-1) FULL-stage units
+    while the virtual schedule's is (pp*vpp-1) CHUNK units = (pp-1) + a
+    vpp-fraction — `makespan < plain_makespan` for n_mu >= pp (asserted
+    in tests, reported here).
+    """
+    from shallowspeed_tpu.parallel.schedules import PipeDreamSchedule
+
+    n_mu = num_micro_batches
+    depth = pp * vpp
+    logical = simulate(PipeDreamSchedule, n_mu, depth)
+    plain = simulate(PipeDreamSchedule, n_mu, pp)
+
+    # per-logical-stage op streams in 1F1B order: ("F"|"B", mu)
+    def stream(stage):
+        ops = []
+        warm = min(depth - stage - 1, n_mu)
+        ops += [("F", m) for m in range(warm)]
+        for i in range(n_mu - warm):
+            ops += [("F", warm + i), ("B", i)]
+        ops += [("B", m) for m in range(n_mu - warm, n_mu)]
+        return ops
+
+    streams = {ls: stream(ls) for ls in range(depth)}
+    pos = {ls: 0 for ls in range(depth)}
+    f_done = {}                      # (ls, mu) -> completion round
+    b_done = {}
+    stash = [0] * pp                 # per-device in-flight forwards
+    peak = [0] * pp
+    rounds = 0
+    total_ops = sum(len(s) for s in streams.values())
+    done_ops = 0
+
+    def ready(ls, rnd):
+        if pos[ls] >= len(streams[ls]):
+            return False
+        op, mu = streams[ls][pos[ls]]
+        if op == "F":
+            return ls == 0 or f_done.get((ls - 1, mu), rnd) < rnd
+        return (f_done.get((ls, mu), rnd) < rnd
+                and (ls == depth - 1
+                     or b_done.get((ls + 1, mu), rnd) < rnd))
+
+    while done_ops < total_ops:
+        progressed = False
+        for d in range(pp):
+            cands = [ls for ls in range(d, depth, pp) if ready(ls, rounds)]
+            if not cands:
+                continue
+            # drain-first: backwards beat forwards; deeper chunks first
+            def prio(ls):
+                op, mu = streams[ls][pos[ls]]
+                return (0 if op == "B" else 1, -ls, mu)
+
+            ls = min(cands, key=prio)
+            op, mu = streams[ls][pos[ls]]
+            if op == "F":
+                f_done[(ls, mu)] = rounds
+                stash[d] += 1
+                peak[d] = max(peak[d], stash[d])
+            else:
+                b_done[(ls, mu)] = rounds
+                stash[d] -= 1
+            pos[ls] += 1
+            done_ops += 1
+            progressed = True
+        rounds += 1
+        if not progressed and done_ops < total_ops:
+            raise ScheduleError(
+                f"interleaved schedule wedged at round {rounds} "
+                f"(pp={pp}, vpp={vpp}, n_mu={n_mu})")
+
+    return InterleavedReport(
+        makespan=rounds,
+        plain_makespan=plain.makespan * vpp,
+        peak_stash=peak,
+        logical=logical,
+    )

@@ -37,6 +37,10 @@ def parse_args(argv=None):
                    help="compiled pipeline schedule: gpipe (autodiff "
                         "backward) or 1f1b (PipeDream-Flush: bounded "
                         "min(pp, n_mu) activation stash)")
+    p.add_argument("--virtual-pp", type=int, default=1,
+                   help="interleaved virtual pipeline stages per device "
+                        "(Megatron-style; gpipe schedule, needs "
+                        "n_layers %% (pp*virtual_pp) == 0)")
     p.add_argument("--n-mubatches", type=int, default=4,
                    help="microbatches per batch in the pipeline (--pp > 1)")
     p.add_argument("--sp", type=int, default=1,
@@ -53,6 +57,15 @@ def parse_args(argv=None):
     p.add_argument("--experts", type=int, default=0,
                    help="number of MoE experts per block (0 = dense FFN)")
     p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-capacity-factor", type=float, default=2.0,
+                   help="expert buffer slots = cf * top_k * tokens / E; "
+                        "lower = faster steps, more dropped assignments "
+                        "(drop fraction is logged per step)")
+    p.add_argument("--moe-routing", default="sequence",
+                   choices=["sequence", "priority"],
+                   help="expert slot assignment: sequence order (GShard) "
+                        "or batch-priority (V-MoE: overflow drops the "
+                        "router's least-confident assignments)")
     p.add_argument("--moe-z-weight", type=float, default=0.0,
                    help="router z-loss weight (ST-MoE stabilizer; "
                         "1e-3 typical, 0 = off)")
@@ -407,7 +420,9 @@ def train(args) -> float:
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             max_seq=args.seq_len, n_experts=args.experts,
                             moe_top_k=args.moe_top_k,
+                            moe_capacity_factor=args.moe_capacity_factor,
                             moe_z_weight=args.moe_z_weight,
+                            moe_routing=args.moe_routing,
                             compute_dtype=jnp.bfloat16 if args.bf16 else None,
                             remat=args.remat,
                             remat_policy=args.remat_policy,
@@ -464,7 +479,8 @@ def train(args) -> float:
                                   n_mubatches=args.n_mubatches,
                                   seed=args.seed,
                                   schedule=args.pp_schedule,
-                                  attn=pp_attn)
+                                  attn=pp_attn,
+                                  virtual_pp=args.virtual_pp)
     elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
