@@ -13,11 +13,21 @@ def expect_exit(argv, match):
         train(parse_args(argv))
 
 
-def test_pp_excludes_fsdp_zero1_sp_ep():
-    for extra in (["--fsdp"], ["--zero1"], ["--sp", "2"],
+def test_pp_excludes_fsdp_zero1_ep():
+    # round 3: --sp and --experts now COMPOSE with --pp; the sharded-
+    # state family and ep still don't
+    for extra in (["--fsdp"], ["--zero1"],
                   ["--ep", "2", "--experts", "2"]):
-        expect_exit(["--pp", "2"] + extra, "--pp composes with --dp and "
-                                           "--tp only")
+        expect_exit(["--pp", "2"] + extra,
+                    "--pp composes with --dp, --tp, --sp")
+
+
+def test_pp_sp_guards():
+    # one extra model axis only, and sp needs a sequence-parallel substrate
+    expect_exit(["--pp", "2", "--sp", "2", "--tp", "2"],
+                "ONE extra model axis")
+    expect_exit(["--pp", "2", "--sp", "2", "--attn", "flash"],
+                "sequence-parallel attention substrate")
 
 
 def test_ep_requires_experts():
