@@ -61,7 +61,8 @@ def _group(q: Array, kvh: int):
 
 
 def attention(q: Array, k: Array, v: Array, causal: bool = True,
-              window: int = 0) -> Array:
+              window: int = 0, dropout: float = 0.0,
+              dropout_key=None) -> Array:
     """Multi-head scaled-dot-product attention.
 
     q: (batch, seq, heads, head_dim); k, v: (batch, seq, kv_heads,
@@ -70,6 +71,14 @@ def attention(q: Array, k: Array, v: Array, causal: bool = True,
     attends to positions <= i; `window > 0` additionally restricts
     attention to the last `window` positions (sliding-window / local
     attention, Mistral-style: position i sees [i - window + 1, i]).
+
+    `dropout`/`dropout_key`: ATTENTION-PROBABILITY dropout (the classic
+    pre-AV-matmul mask) — active only when both are set; inverted
+    scaling keeps the expectation. This exists only on this plain
+    substrate: the fused flash kernels and the resharded ring/ulysses
+    paths deliberately reject it (`cfg.attn_dropout` guards at config
+    time), because a probability mask would have to materialize inside
+    the fused/streamed score blocks.
 
     Mixed-precision safe: scores accumulate in float32 on the MXU
     (`preferred_element_type`) and the softmax runs in float32 regardless
@@ -91,6 +100,10 @@ def attention(q: Array, k: Array, v: Array, causal: bool = True,
             mask = mask & (ik > iq - window)
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = 1.0 - dropout
+        dmask = jax.random.bernoulli(dropout_key, keep, p.shape)
+        p = jnp.where(dmask, p / keep, 0.0)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, tq, h, d).astype(q.dtype)
@@ -98,6 +111,7 @@ def attention(q: Array, k: Array, v: Array, causal: bool = True,
 
 attention.supports_gqa = True
 attention.supports_window = True
+attention.supports_prob_dropout = True
 
 
 def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,

@@ -35,7 +35,8 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from shallowspeed_tpu.models import transformer as T
-from shallowspeed_tpu.ops.attention import ring_attention, ulysses_attention
+from shallowspeed_tpu.ops.attention import (attention, ring_attention,
+                                            ulysses_attention)
 from shallowspeed_tpu.utils import pvary_over
 
 tree_map = jax.tree_util.tree_map
@@ -85,7 +86,15 @@ class ContextParallelEngine:
         # `window=` with identical semantics (`ops/attention.py` masks,
         # the flash kernel skips out-of-window tiles outright).
         w = cfg.attn_window
-        if attn == "flash":
+        if cfg.attn_dropout > 0.0:
+            # probability dropout lives on the plain substrate only; at
+            # sp=1 the ring degenerates to it, so swap transparently
+            assert self.sp == 1 and attn == "ring", (
+                "cfg.attn_dropout needs the plain XLA attention "
+                "substrate (sp=1, --attn ring); fused/resharded "
+                "substrates cannot mask probabilities")
+            attn = partial(attention, causal=True, window=w)
+        elif attn == "flash":
             from shallowspeed_tpu.ops.flash_attention import flash_attention
 
             assert self.sp == 1, "--attn flash requires sp=1 (use ring)"
@@ -128,7 +137,7 @@ class ContextParallelEngine:
                           train=train)
 
         def train_key(step):
-            if cfg.dropout == 0.0:
+            if cfg.dropout == 0.0 and cfg.attn_dropout == 0.0:
                 return None
             return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 

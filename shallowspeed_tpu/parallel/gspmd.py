@@ -64,7 +64,7 @@ class GSPMDEngine:
         def train_key(step):
             """Per-step dropout key (None when the config has no dropout,
             keeping RNG out of the trace); deterministic in (seed, step)."""
-            if cfg.dropout == 0.0:
+            if cfg.dropout == 0.0 and cfg.attn_dropout == 0.0:
                 return None
             return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 

@@ -174,6 +174,10 @@ class PipelineLMEngine:
             assert cfg.n_heads % self.sp == 0 and \
                 cfg.kv_heads % self.sp == 0, (
                     "ulysses-flash needs head counts divisible by sp")
+        assert cfg.attn_dropout == 0.0, (
+            "attention-probability dropout is not available in the "
+            "pipeline engine (plain-substrate only; see "
+            "TransformerConfig.attn_dropout)")
         assert cfg.n_experts == 0 or not self.has_tp, (
             "MoE x tp is not supported in the pipeline engine (MoE "
             "composes with dp/pp/sp here, and with dp/ep in "

@@ -221,3 +221,86 @@ def test_generate_never_drops():
     a = generate(params, prompt, CFG, max_new=8, seed=1, temperature=1.0)
     b_ = generate(params, prompt, CFG, max_new=8, seed=1, temperature=1.0)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+# --------------------------------- attention-probability dropout (round 3)
+
+
+def test_attn_dropout_changes_training_only():
+    """cfg.attn_dropout masks attention probabilities during TRAINING
+    steps (loss differs from the clean config) while eval stays
+    bit-identical to no-dropout (key is None there)."""
+    from dataclasses import replace as _replace
+
+    cfg0 = T.TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                               n_layers=1, max_seq=16)
+    cfgd = _replace(cfg0, attn_dropout=0.5)
+    params = T.init(cfg0, seed=1)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 32, (2, 16)).astype(np.int32)
+    tgt = np.roll(tok, -1, axis=1)
+    key = jax.random.PRNGKey(7)
+    l_clean = float(T.loss(params, tok, tgt, cfg0, dropout_key=key))
+    l_drop = float(T.loss(params, tok, tgt, cfgd, dropout_key=key))
+    assert abs(l_clean - l_drop) > 1e-4
+    # eval (no key): identical to clean
+    assert float(T.loss(params, tok, tgt, cfgd)) == pytest.approx(
+        l_clean, abs=1e-7)
+    # deterministic given the key
+    assert float(T.loss(params, tok, tgt, cfgd, dropout_key=key)) \
+        == pytest.approx(l_drop, abs=1e-7)
+
+
+def test_attn_dropout_composes_with_output_dropout():
+    from dataclasses import replace as _replace
+
+    cfg = T.TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                              n_layers=1, max_seq=16, dropout=0.1,
+                              attn_dropout=0.2)
+    params = T.init(cfg, seed=1)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 32, (2, 16)).astype(np.int32)
+    l = float(T.loss(params, tok, np.roll(tok, -1, 1), cfg,
+                     dropout_key=jax.random.PRNGKey(3)))
+    assert np.isfinite(l)
+
+
+def test_attn_dropout_rejected_on_fused_substrates():
+    from dataclasses import replace as _replace
+
+    from shallowspeed_tpu.models.transformer import TransformerConfig
+    from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+    from shallowspeed_tpu.parallel.context import ContextParallelEngine
+    from shallowspeed_tpu.optim import SGD
+    from jax.sharding import Mesh as _Mesh
+
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=2,
+                            max_seq=16, attn_dropout=0.1)
+    with pytest.raises(AssertionError, match="attention-probability"):
+        PipelineLMEngine(cfg, SGD(0.1), _Mesh(
+            np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "pp")))
+    with pytest.raises(AssertionError, match="plain XLA attention"):
+        ContextParallelEngine(cfg, SGD(0.1), _Mesh(
+            np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "sp")))
+
+
+def test_attn_dropout_context_engine_trains():
+    """sp=1 context engine transparently swaps ring -> plain attention
+    and trains with the probability mask."""
+    from dataclasses import replace as _replace
+
+    from shallowspeed_tpu.models.transformer import TransformerConfig
+    from shallowspeed_tpu.parallel.context import ContextParallelEngine
+    from shallowspeed_tpu.optim import Adam
+    from jax.sharding import Mesh as _Mesh
+
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=1,
+                            max_seq=16, attn_dropout=0.2)
+    eng = ContextParallelEngine(cfg, Adam(5e-3), _Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp")), seed=0)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 32, (4, 16)).astype(np.int32)
+    tgt = np.roll(tok, -1, axis=1).astype(np.int32)
+    losses = [eng.train_batch(tok, tgt) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

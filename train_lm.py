@@ -120,6 +120,11 @@ def parse_args(argv=None):
     p.add_argument("--label-smoothing", type=float, default=0.0,
                    help="mix the one-hot target with the uniform "
                         "distribution in the loss")
+    p.add_argument("--attn-dropout", type=float, default=0.0,
+                   help="attention-PROBABILITY dropout (pre-AV-matmul "
+                        "mask); plain XLA attention substrate only — "
+                        "rejected with --pp, --sp>1, or a fused "
+                        "substrate")
     p.add_argument("--dropout", type=float, default=0.0,
                    help="dropout rate on embeddings and attention/FFN "
                         "outputs (GPT-2 placement); active in training "
@@ -394,6 +399,11 @@ def train(args) -> float:
     if args.experts and args.moe_top_k > args.experts:
         raise SystemExit(f"--moe-top-k {args.moe_top_k} cannot exceed "
                          f"--experts {args.experts}")
+    if args.attn_dropout > 0.0 and (
+            args.pp > 1 or args.sp > 1
+            or args.attn not in ("ring",)):
+        raise SystemExit("--attn-dropout needs the plain XLA attention "
+                         "substrate (no --pp/--sp>1, --attn ring)")
     if args.experts and args.pp <= 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with "
                          "--experts (the MoE engine uses XLA attention)")
@@ -431,6 +441,7 @@ def train(args) -> float:
                             norm=args.norm, ffn=args.ffn,
                             n_kv_heads=args.kv_heads,
                             dropout=args.dropout,
+                            attn_dropout=args.attn_dropout,
                             tie_embeddings=args.tie_embeddings,
                             label_smoothing=args.label_smoothing,
                             logit_softcap=args.logit_softcap,
