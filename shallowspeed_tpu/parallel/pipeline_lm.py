@@ -144,7 +144,7 @@ class PipelineLMEngine:
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  n_mubatches: int = 4, seed: int = 0,
                  schedule: str = "gpipe", attn: str = "xla",
-                 virtual_pp: int = 1):
+                 virtual_pp: int = 1, zero1: bool = False):
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp"),
                                    ("dp", "pp", "sp")), (
             f"PipelineLMEngine expects a ('dp','pp'[,'tp'|'sp']) mesh, "
@@ -203,6 +203,9 @@ class PipelineLMEngine:
         assert cfg.kv_heads % self.tp == 0, (
             f"n_kv_heads={cfg.kv_heads} must be divisible by tp={self.tp}")
         assert cfg.ffn_dim % self.tp == 0
+        self.zero1 = zero1
+        if zero1:
+            assert self.dp > 1, "--zero1 shards over dp; need dp > 1"
         self.n_mu = n_mubatches
         self.l_local = cfg.n_layers // self.pp
         self.optimizer = optimizer
@@ -859,21 +862,42 @@ class PipelineLMEngine:
                 return None
             return jax.random.fold_in(jax.random.PRNGKey(seed), step)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        @partial(shard_map, mesh=self.mesh,
-                 in_specs=(pspecs, ospecs, dspec, dspec, P()),
-                 out_specs=(pspecs, ospecs, P()))
-        def _step(params, opt_state, tokens, targets, step):
+        def _batch_grads(params, tokens, targets, step):
+            """Shared gradient body of BOTH step programs: schedule
+            dispatch, dp-mean loss, dp-mean gradient (psum'd sums / dp
+            — tiles are equal-sized)."""
             key = train_key(step)
             if use_1f1b:
                 loss, grads = local_1f1b(params, tokens, targets, key)
                 loss = jax.lax.pmean(loss, "dp")
             else:
                 loss, grads = grads_and_loss(params, tokens, targets, key)
-            # dp-mean gradient: psum'd sums / dp (tiles are equal-sized)
             grads = tree_map(lambda g: g / self.dp, grads)
+            return loss, grads
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(pspecs, ospecs, dspec, dspec, P()),
+                 out_specs=(pspecs, ospecs, P()))
+        def _step(params, opt_state, tokens, targets, step):
+            loss, grads = _batch_grads(params, tokens, targets, step)
             params, opt_state = opt.step(params, grads, opt_state)
             return params, opt_state, loss
+
+        # ZeRO-1 x pp: the moments shard over 'dp' ON TOP of their
+        # pp-sharded block placement (zero.py adds 'dp' to the first
+        # free divisible dim), the gradient program stays this engine's
+        # shard_map, and the optimizer update becomes a separate GSPMD
+        # program pinned to those shardings — each device updates its
+        # 1/dp slice of its pipeline stage and XLA all-gathers the new
+        # params over 'dp' only (same split-step recipe as the context
+        # engine's zero1 path).
+        @jax.jit
+        @partial(shard_map, mesh=self.mesh,
+                 in_specs=(pspecs, dspec, dspec, P()),
+                 out_specs=(P(), pspecs))
+        def _loss_grads(params, tokens, targets, step):
+            return _batch_grads(params, tokens, targets, step)
 
         @jax.jit
         @partial(shard_map, mesh=self.mesh,
@@ -884,7 +908,20 @@ class PipelineLMEngine:
                                 ("pp", "sp") if self.has_sp else "pp")
             return jax.lax.pmean(loss, "dp")
 
-        self._step_fn = _step
+        if self.zero1:
+            from shallowspeed_tpu.parallel.zero import (
+                make_zero1_update, shard_state_zero1)
+
+            self.opt_state = shard_state_zero1(self.opt_state, self.mesh)
+            # the GSPMD update uses the CALLER's optimizer (no manual
+            # clip axes: the global-norm reduction over pp/dp-sharded
+            # leaves is GSPMD's job in this program)
+            self._update_fn = make_zero1_update(
+                self.optimizer, self.params, self.opt_state)
+            self._loss_grads_fn = _loss_grads
+            self._step_fn = None
+        else:
+            self._step_fn = _step
         self._eval_fn = _eval
 
     # ----------------------------------------------------------------- data
@@ -921,6 +958,13 @@ class PipelineLMEngine:
     def train_batch_async(self, tokens, targets) -> jax.Array:
         step = np.uint32(self._step_count)
         self._step_count += 1
+        if self._step_fn is None:  # zero1: grad program + GSPMD update
+            loss, grads = self._loss_grads_fn(
+                self.params, self.place(tokens), self.place(targets),
+                step)
+            self.params, self.opt_state = self._update_fn(
+                self.params, grads, self.opt_state)
+            return loss
         self.params, self.opt_state, loss = self._step_fn(
             self.params, self.opt_state, self.place(tokens),
             self.place(targets), step)
