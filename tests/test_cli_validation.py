@@ -13,13 +13,15 @@ def expect_exit(argv, match):
         train(parse_args(argv))
 
 
-def test_pp_excludes_fsdp_zero1_ep():
-    # round 3: --sp and --experts now COMPOSE with --pp; the sharded-
-    # state family and ep still don't
-    for extra in (["--fsdp"], ["--zero1"],
+def test_pp_excludes_fsdp_zero2_ep():
+    # round 3: --sp, --experts, and --zero1 now COMPOSE with --pp;
+    # --fsdp/--zero2/--ep still don't
+    for extra in (["--fsdp"], ["--zero2"],
                   ["--ep", "2", "--experts", "2"]):
         expect_exit(["--pp", "2"] + extra,
                     "--pp composes with --dp, --tp, --sp")
+    expect_exit(["--pp", "2", "--zero1"],  # dp=1 has nothing to shard
+                "--zero1 shards moments over dp")
 
 
 def test_pp_sp_guards():
