@@ -144,7 +144,8 @@ class PipelineLMEngine:
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  n_mubatches: int = 4, seed: int = 0,
                  schedule: str = "gpipe", attn: str = "xla",
-                 virtual_pp: int = 1, zero1: bool = False):
+                 virtual_pp: int = 1, zero1: bool = False,
+                 zero2: bool = False):
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp"),
                                    ("dp", "pp", "sp")), (
             f"PipelineLMEngine expects a ('dp','pp'[,'tp'|'sp']) mesh, "
@@ -203,9 +204,15 @@ class PipelineLMEngine:
         assert cfg.kv_heads % self.tp == 0, (
             f"n_kv_heads={cfg.kv_heads} must be divisible by tp={self.tp}")
         assert cfg.ffn_dim % self.tp == 0
-        self.zero1 = zero1
-        if zero1:
-            assert self.dp > 1, "--zero1 shards over dp; need dp > 1"
+        assert not (zero1 and zero2), "zero2 subsumes zero1"
+        self.zero1, self.zero2 = zero1, zero2
+        if zero1 or zero2:
+            assert self.dp > 1, "--zero1/--zero2 shard over dp; need dp > 1"
+        if zero2:
+            assert not self.has_sp and not self.has_tp and \
+                virtual_pp == 1, (
+                    "zero2 x pp supports the plain ('dp','pp') mesh "
+                    "(no sp/tp axis, no virtual stages)")
         self.n_mu = n_mubatches
         self.l_local = cfg.n_layers // self.pp
         self.optimizer = optimizer
@@ -665,6 +672,52 @@ class PipelineLMEngine:
             for sp in jax.tree_util.tree_leaves(
                 self._pspecs, is_leaf=lambda x: isinstance(x, P))]
 
+        def reduce_plain(grads):
+            g_leaves, tdef = jax.tree_util.tree_flatten(grads)
+            g_leaves = [jax.lax.psum(g, ax) if ax else g
+                        for g, ax in zip(g_leaves, grad_psum_axes)]
+            return jax.tree_util.tree_unflatten(tdef, g_leaves)
+
+        if self.zero2:
+            from shallowspeed_tpu.parallel.zero import (zero2_grad_dim,
+                                                        zero2_grad_specs)
+
+            # ZeRO-2 gradient layout: each leaf's param spec plus 'dp'
+            # on its first free divisible dim — identical rule to the
+            # ZeRO-1 moment placement, so the sharded update is local
+            self._gspecs2 = zero2_grad_specs(self.params, self.mesh)
+            scatter_dims = [
+                zero2_grad_dim(sp_, l.shape, self.dp)
+                for sp_, l in zip(
+                    jax.tree_util.tree_leaves(
+                        self._pspecs,
+                        is_leaf=lambda x: isinstance(x, P)),
+                    jax.tree_util.tree_leaves(self.params))]
+
+            def reduce_scatter_dp(grads):
+                """Raw per-device partials -> dp-SHARDED grads: psum the
+                non-dp axes, reduce-scatter 'dp' on the leaf's ZeRO dim
+                (plain psum when no dim qualifies — that leaf's update
+                stays replicated, like zero.py's placement rule)."""
+                g_leaves, tdef = jax.tree_util.tree_flatten(grads)
+                out = []
+                for g, axes, dim in zip(g_leaves, grad_psum_axes,
+                                        scatter_dims):
+                    rest = tuple(a for a in axes if a != "dp")
+                    if rest:
+                        g = jax.lax.psum(g, rest)
+                    if "dp" in axes:
+                        if dim is not None:
+                            g = jax.lax.psum_scatter(
+                                g, "dp", scatter_dimension=dim,
+                                tiled=True)
+                        else:
+                            g = jax.lax.psum(g, "dp")
+                    out.append(g)
+                return jax.tree_util.tree_unflatten(tdef, out)
+
+            self._reduce_scatter_dp = reduce_scatter_dp
+
         def stage_fwd(params_c, x_in, tok_m, tgt_m, keys=(None, None)):
             """One stage's whole tick on already-cast params: embed (if
             first), this stage's blocks, head + token NLL. Returns
@@ -693,11 +746,15 @@ class PipelineLMEngine:
             contrib = jnp.where(s == pp - 1, nll, 0.0) + aux
             return h, contrib
 
-        def local_1f1b(params, tokens, targets, key=None):
+        def local_1f1b(params, tokens, targets, key=None,
+                       grad_reduce=None):
             """The full 1F1B batch step body (inside shard_map): returns
             (local-mean loss, accumulated f32 grads). Slot algebra:
             F(s, m) at tick 2m+s, B(s, m) at tick 2m+2pp-1-s — disjoint
-            (odd difference), immediate-consumption both directions."""
+            (odd difference), immediate-consumption both directions.
+            `grad_reduce` maps the raw per-device partial grads to their
+            reduced form (default: psum per grad_psum_axes; the ZeRO-2
+            path substitutes a dp reduce-scatter)."""
             s = jax.lax.axis_index("pp")
             is_last = s == pp - 1
             uniform = self.has_sp  # see the collective-schedule note below
@@ -837,10 +894,7 @@ class PipelineLMEngine:
             (_, _, _, grads, loss_sum), _ = jax.lax.scan(
                 tick, init, jnp.arange(2 * (n_mu + pp - 1)))
 
-            g_leaves, tdef = jax.tree_util.tree_flatten(grads)
-            g_leaves = [jax.lax.psum(g, ax) if ax else g
-                        for g, ax in zip(g_leaves, grad_psum_axes)]
-            grads = jax.tree_util.tree_unflatten(tdef, g_leaves)
+            grads = (grad_reduce or reduce_plain)(grads)
             loss = jax.lax.psum(
                 loss_sum, ("pp", "sp") if self.has_sp else "pp") \
                 / (n_mu * sp)
@@ -908,7 +962,36 @@ class PipelineLMEngine:
                                 ("pp", "sp") if self.has_sp else "pp")
             return jax.lax.pmean(loss, "dp")
 
-        if self.zero1:
+        if self.zero2:
+            # ZeRO-2 x pp: grads leave the shard_map dp-SHARDED (one
+            # reduce-scatter per leaf instead of the all-reduce), leaf-
+            # aligned with the ZeRO-1-placed moments, so the GSPMD
+            # update below runs fully local and all-gathers params only.
+            # GPipe takes the pvaried-params route (like 1F1B) so the
+            # cotangents arrive as per-device partials for us to scatter.
+            @jax.jit
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=(pspecs, dspec, dspec, P()),
+                     out_specs=(P(), self._gspecs2))
+            def _loss_grads2(params, tokens, targets, step):
+                key = train_key(step)
+                if use_1f1b:
+                    loss, grads = local_1f1b(
+                        params, tokens, targets, key,
+                        grad_reduce=self._reduce_scatter_dp)
+                else:
+                    (loss, _), raw = jax.value_and_grad(
+                        local_loss, has_aux=True)(
+                            _pvary(params, vary_axes), tokens, targets,
+                            key)
+                    grads = self._reduce_scatter_dp(raw)
+                    loss = jax.lax.psum(loss, "pp")
+                loss = jax.lax.pmean(loss, "dp")
+                grads = tree_map(lambda g: g / self.dp, grads)
+                return loss, grads
+
+            self._loss_grads_fn = _loss_grads2
+        if self.zero1 or self.zero2:
             from shallowspeed_tpu.parallel.zero import (
                 make_zero1_update, shard_state_zero1)
 
@@ -918,7 +1001,8 @@ class PipelineLMEngine:
             # leaves is GSPMD's job in this program)
             self._update_fn = make_zero1_update(
                 self.optimizer, self.params, self.opt_state)
-            self._loss_grads_fn = _loss_grads
+            if self.zero1:
+                self._loss_grads_fn = _loss_grads
             self._step_fn = None
         else:
             self._step_fn = _step

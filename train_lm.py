@@ -346,13 +346,16 @@ def train(args) -> float:
                          f"token prompt exceeds --seq-len {args.seq_len} "
                          f"(= max_seq)")
     composite = args.sp > 1 and args.tp > 1
-    if args.pp > 1 and (args.ep > 1 or args.fsdp or args.zero2):
+    if args.pp > 1 and (args.ep > 1 or args.fsdp):
         raise SystemExit("--pp composes with --dp, --tp, --sp, "
-                         "--experts, and --zero1 (not --ep/--fsdp/"
-                         "--zero2)")
-    if args.pp > 1 and args.zero1 and args.dp < 2:
-        raise SystemExit("--pp with --zero1 shards moments over dp; "
+                         "--experts, and --zero1/--zero2 (not "
+                         "--ep/--fsdp)")
+    if args.pp > 1 and (args.zero1 or args.zero2) and args.dp < 2:
+        raise SystemExit("--pp with --zero1/--zero2 shards over dp; "
                          "need --dp >= 2")
+    if args.pp > 1 and args.zero2 and (args.sp > 1 or args.tp > 1):
+        raise SystemExit("--pp with --zero2 takes the plain ('dp','pp') "
+                         "mesh (no --sp/--tp)")
     if args.pp > 1 and args.sp > 1 and args.tp > 1:
         raise SystemExit("--pp takes ONE extra model axis: --tp or --sp")
     if args.pp > 1 and args.experts and args.tp > 1:
@@ -495,7 +498,7 @@ def train(args) -> float:
                                   schedule=args.pp_schedule,
                                   attn=pp_attn,
                                   virtual_pp=args.virtual_pp,
-                                  zero1=args.zero1)
+                                  zero1=args.zero1, zero2=args.zero2)
     elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
