@@ -145,7 +145,7 @@ class PipelineLMEngine:
                  n_mubatches: int = 4, seed: int = 0,
                  schedule: str = "gpipe", attn: str = "xla",
                  virtual_pp: int = 1, zero1: bool = False,
-                 zero2: bool = False):
+                 zero2: bool = False, fsdp: bool = False):
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp"),
                                    ("dp", "pp", "sp")), (
             f"PipelineLMEngine expects a ('dp','pp'[,'tp'|'sp']) mesh, "
@@ -204,14 +204,16 @@ class PipelineLMEngine:
         assert cfg.kv_heads % self.tp == 0, (
             f"n_kv_heads={cfg.kv_heads} must be divisible by tp={self.tp}")
         assert cfg.ffn_dim % self.tp == 0
-        assert not (zero1 and zero2), "zero2 subsumes zero1"
-        self.zero1, self.zero2 = zero1, zero2
-        if zero1 or zero2:
-            assert self.dp > 1, "--zero1/--zero2 shard over dp; need dp > 1"
-        if zero2:
+        assert sum((zero1, zero2, fsdp)) <= 1, (
+            "pick ONE of zero1 / zero2 / fsdp (each subsumes the last)")
+        self.zero1, self.zero2, self.fsdp = zero1, zero2, fsdp
+        if zero1 or zero2 or fsdp:
+            assert self.dp > 1, (
+                "--zero1/--zero2/--fsdp shard over dp; need dp > 1")
+        if zero2 or fsdp:
             assert not self.has_sp and not self.has_tp and \
                 virtual_pp == 1, (
-                    "zero2 x pp supports the plain ('dp','pp') mesh "
+                    "zero2/fsdp x pp support the plain ('dp','pp') mesh "
                     "(no sp/tp axis, no virtual stages)")
         self.n_mu = n_mubatches
         self.l_local = cfg.n_layers // self.pp
@@ -259,9 +261,29 @@ class PipelineLMEngine:
         }
         if not cfg.tie_embeddings:
             self._pspecs["head"] = {"W": P(), "b": P()}
-        self.params = jax.device_put(
-            host, tree_map(lambda s: NamedSharding(mesh, s), self._pspecs,
-                           is_leaf=lambda x: isinstance(x, P)))
+        if fsdp:
+            # ZeRO-3-style: the RESTING placement adds 'dp' to every
+            # leaf's first free divisible dim (zero.py's rule) — master
+            # params, and through init-inheritance the moments, live
+            # 1/dp per device; the step gathers each stage's params
+            # transiently and reduce-scatters the grads back.
+            from shallowspeed_tpu.parallel.zero import zero2_grad_specs
+
+            tmp = jax.device_put(
+                host, tree_map(lambda s: NamedSharding(mesh, s),
+                               self._pspecs,
+                               is_leaf=lambda x: isinstance(x, P)))
+            self._store_specs = zero2_grad_specs(tmp, mesh)
+            self.params = jax.device_put(
+                host, tree_map(lambda s: NamedSharding(mesh, s),
+                               self._store_specs,
+                               is_leaf=lambda x: isinstance(x, P)))
+        else:
+            self._store_specs = self._pspecs
+            self.params = jax.device_put(
+                host, tree_map(lambda s: NamedSharding(mesh, s),
+                               self._pspecs,
+                               is_leaf=lambda x: isinstance(x, P)))
         template = optimizer.init(self.params)
         self.opt_state = tree_map(
             lambda l: l if isinstance(getattr(l, "sharding", None),
@@ -678,14 +700,17 @@ class PipelineLMEngine:
                         for g, ax in zip(g_leaves, grad_psum_axes)]
             return jax.tree_util.tree_unflatten(tdef, g_leaves)
 
-        if self.zero2:
+        if self.zero2 or self.fsdp:
             from shallowspeed_tpu.parallel.zero import (zero2_grad_dim,
                                                         zero2_grad_specs)
 
             # ZeRO-2 gradient layout: each leaf's param spec plus 'dp'
             # on its first free divisible dim — identical rule to the
-            # ZeRO-1 moment placement, so the sharded update is local
-            self._gspecs2 = zero2_grad_specs(self.params, self.mesh)
+            # ZeRO-1 moment placement, so the sharded update is local.
+            # Under fsdp the params ALREADY rest at that placement, so
+            # the grad specs coincide with the storage specs.
+            self._gspecs2 = (self._store_specs if self.fsdp else
+                             zero2_grad_specs(self.params, self.mesh))
             scatter_dims = [
                 zero2_grad_dim(sp_, l.shape, self.dp)
                 for sp_, l in zip(
@@ -962,18 +987,20 @@ class PipelineLMEngine:
                                 ("pp", "sp") if self.has_sp else "pp")
             return jax.lax.pmean(loss, "dp")
 
-        if self.zero2:
+        if self.zero2 or self.fsdp:
             # ZeRO-2 x pp: grads leave the shard_map dp-SHARDED (one
             # reduce-scatter per leaf instead of the all-reduce), leaf-
             # aligned with the ZeRO-1-placed moments, so the GSPMD
             # update below runs fully local and all-gathers params only.
             # GPipe takes the pvaried-params route (like 1F1B) so the
             # cotangents arrive as per-device partials for us to scatter.
-            @jax.jit
-            @partial(shard_map, mesh=self.mesh,
-                     in_specs=(pspecs, dspec, dspec, P()),
-                     out_specs=(P(), self._gspecs2))
-            def _loss_grads2(params, tokens, targets, step):
+            # fsdp adds the other half of ZeRO-3: params REST dp-sharded
+            # (in_specs = the sharded layout) and each step all-gathers
+            # the stage's params transiently before computing.
+            fsdp = self.fsdp
+            scatter_dims_ = scatter_dims
+
+            def _z2_grads(params, tokens, targets, step):
                 key = train_key(step)
                 if use_1f1b:
                     loss, grads = local_1f1b(
@@ -990,12 +1017,45 @@ class PipelineLMEngine:
                 grads = tree_map(lambda g: g / self.dp, grads)
                 return loss, grads
 
+            def _gather_params(params):
+                leaves, tdef = jax.tree_util.tree_flatten(params)
+                full = [jax.lax.all_gather(l, "dp", axis=dim,
+                                           tiled=True)
+                        if dim is not None else l
+                        for l, dim in zip(leaves, scatter_dims_)]
+                return jax.tree_util.tree_unflatten(tdef, full)
+
+            in_pspec = self._gspecs2 if fsdp else pspecs
+
+            @jax.jit
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=(in_pspec, dspec, dspec, P()),
+                     out_specs=(P(), self._gspecs2))
+            def _loss_grads2(params, tokens, targets, step):
+                if fsdp:
+                    params = _gather_params(params)
+                return _z2_grads(params, tokens, targets, step)
+
             self._loss_grads_fn = _loss_grads2
-        if self.zero1 or self.zero2:
+
+            @jax.jit
+            @partial(shard_map, mesh=self.mesh,
+                     in_specs=(in_pspec, dspec, dspec), out_specs=P())
+            def _eval_z(params, tokens, targets):
+                if fsdp:
+                    params = _gather_params(params)
+                loss, _ = loss_fn(params, tokens, targets, train=False)
+                loss = jax.lax.psum(loss, "pp")
+                return jax.lax.pmean(loss, "dp")
+
+            _eval = _eval_z
+        if self.zero1 or self.zero2 or self.fsdp:
             from shallowspeed_tpu.parallel.zero import (
                 make_zero1_update, shard_state_zero1)
 
-            self.opt_state = shard_state_zero1(self.opt_state, self.mesh)
+            if not self.fsdp:  # fsdp moments inherit the placement
+                self.opt_state = shard_state_zero1(self.opt_state,
+                                                   self.mesh)
             # the GSPMD update uses the CALLER's optimizer (no manual
             # clip axes: the global-norm reduction over pp/dp-sharded
             # leaves is GSPMD's job in this program)
@@ -1094,6 +1154,9 @@ class PipelineLMEngine:
         s_right = [(i, (i + 1) % pp) for i in range(pp)]
         assert self.tp == 1 and self.sp == 1, (
             "pipelined decode supports ('dp','pp') meshes (tp/sp size 1)")
+        assert not self.fsdp, (
+            "pipelined decode needs stage-resident params; restore the "
+            "checkpoint into a non-fsdp pipeline to sample")
         attn = partial(attention, causal=True, window=cfg.attn_window)
         dt = cfg.compute_dtype or cfg.dtype
         l_local = self.l_local
@@ -1269,7 +1332,7 @@ class PipelineLMEngine:
         host = self._permute(stack_blocks(tree_map(np.asarray, params)))
         self.params = jax.device_put(
             host, tree_map(lambda s: NamedSharding(self.mesh, s),
-                           self._pspecs,
+                           self._store_specs,
                            is_leaf=lambda x: isinstance(x, P)))
 
     def set_opt_state(self, state):

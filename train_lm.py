@@ -346,16 +346,18 @@ def train(args) -> float:
                          f"token prompt exceeds --seq-len {args.seq_len} "
                          f"(= max_seq)")
     composite = args.sp > 1 and args.tp > 1
-    if args.pp > 1 and (args.ep > 1 or args.fsdp):
+    if args.pp > 1 and args.ep > 1:
         raise SystemExit("--pp composes with --dp, --tp, --sp, "
-                         "--experts, and --zero1/--zero2 (not "
-                         "--ep/--fsdp)")
-    if args.pp > 1 and (args.zero1 or args.zero2) and args.dp < 2:
-        raise SystemExit("--pp with --zero1/--zero2 shards over dp; "
-                         "need --dp >= 2")
-    if args.pp > 1 and args.zero2 and (args.sp > 1 or args.tp > 1):
-        raise SystemExit("--pp with --zero2 takes the plain ('dp','pp') "
-                         "mesh (no --sp/--tp)")
+                         "--experts, --zero1/--zero2, and --fsdp "
+                         "(not --ep)")
+    if args.pp > 1 and (args.zero1 or args.zero2 or args.fsdp) \
+            and args.dp < 2:
+        raise SystemExit("--pp with --zero1/--zero2/--fsdp shards over "
+                         "dp; need --dp >= 2")
+    if args.pp > 1 and (args.zero2 or args.fsdp) \
+            and (args.sp > 1 or args.tp > 1):
+        raise SystemExit("--pp with --zero2/--fsdp takes the plain "
+                         "('dp','pp') mesh (no --sp/--tp)")
     if args.pp > 1 and args.sp > 1 and args.tp > 1:
         raise SystemExit("--pp takes ONE extra model axis: --tp or --sp")
     if args.pp > 1 and args.experts and args.tp > 1:
@@ -374,7 +376,7 @@ def train(args) -> float:
         raise SystemExit("--ep composes with --dp/--sp (not --tp)")
     if args.fsdp and (args.ep > 1 or args.experts or args.zero1
                       or args.zero2):
-        raise SystemExit("--fsdp composes with --dp/--sp/--tp (and already "
+        raise SystemExit("--fsdp composes with --dp/--sp/--tp/--pp (and already "
                          "subsumes --zero1/--zero2; MoE uses --ep)")
     if args.zero1 and args.zero2:
         raise SystemExit("--zero2 subsumes --zero1; pick one")
@@ -498,7 +500,8 @@ def train(args) -> float:
                                   schedule=args.pp_schedule,
                                   attn=pp_attn,
                                   virtual_pp=args.virtual_pp,
-                                  zero1=args.zero1, zero2=args.zero2)
+                                  zero1=args.zero1, zero2=args.zero2,
+                                  fsdp=args.fsdp)
     elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
@@ -796,7 +799,8 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
         prompt, _ = make_batch(args, vocab, 0, text_data)
         prompt = prompt[:1, :16]  # one row, short prefix
     if hasattr(engine, "generate") and getattr(engine, "tp", 1) == 1 \
-            and getattr(engine, "sp", 1) == 1:
+            and getattr(engine, "sp", 1) == 1 \
+            and not getattr(engine, "fsdp", False):
         # pipeline engine: decode ON the pp-sharded params (no re-gather
         # onto one device's memory); token-stream-identical to the
         # replicated path
