@@ -13,15 +13,17 @@ def expect_exit(argv, match):
         train(parse_args(argv))
 
 
-def test_pp_excludes_fsdp_zero2_ep():
-    # round 3: --sp, --experts, and --zero1 now COMPOSE with --pp;
-    # --fsdp/--zero2/--ep still don't
-    for extra in (["--fsdp"], ["--zero2"],
-                  ["--ep", "2", "--experts", "2"]):
-        expect_exit(["--pp", "2"] + extra,
-                    "--pp composes with --dp, --tp, --sp")
-    expect_exit(["--pp", "2", "--zero1"],  # dp=1 has nothing to shard
-                "--zero1 shards moments over dp")
+def test_pp_excludes_ep_and_guards_zero_dp():
+    # round 3: --sp, --experts, and the whole ZeRO family (--zero1/
+    # --zero2/--fsdp) now COMPOSE with --pp; only --ep doesn't
+    expect_exit(["--pp", "2", "--ep", "2", "--experts", "2"],
+                "--pp composes with --dp, --tp, --sp")
+    for z in ("--zero1", "--zero2", "--fsdp"):
+        expect_exit(["--pp", "2", z],  # dp=1 has nothing to shard
+                    "shards over\\s+dp")
+    for z in ("--zero2", "--fsdp"):  # plain ('dp','pp') mesh only
+        expect_exit(["--dp", "2", "--pp", "2", z, "--tp", "2"],
+                    "plain")
 
 
 def test_pp_sp_guards():
