@@ -534,7 +534,7 @@ def test_pp_zero2_matches_dense_pipeline(sched):
 
 
 def test_pp_zero2_guards():
-    with pytest.raises(AssertionError, match="zero2 subsumes"):
+    with pytest.raises(AssertionError, match="pick ONE"):
         PipelineLMEngine(CFG, Adam(1e-2), pp_mesh(2, 2), zero1=True,
                          zero2=True)
     devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
