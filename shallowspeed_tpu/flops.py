@@ -14,12 +14,20 @@ deliberately NOT counted (that is what makes this MFU, not HFU).
 
 from __future__ import annotations
 
-# Peak dense matmul throughput per chip, FLOP/s. Sources: published TPU
-# spec sheets (bf16); f32 entries are the measured-practical MXU f32
-# ratio (~1/8 of bf16 on v4/v5 generations via multi-pass emulation).
+# Peak dense matmul throughput per JAX DEVICE, FLOP/s (bf16, published
+# spec sheets). The unit is deliberately the device, not the chip: on
+# v2/v3 JAX exposes each TensorCore as a separate device (2 per chip —
+# `jax.local_devices()` on a v3-8 host lists 8 devices on 4 chips), so
+# their entries are the per-core half of the chip spec (v2: 45/2, v3:
+# 123/2). From v4 on the two cores are fused (megacore) and device ==
+# chip, so those entries are chip peaks. This is what makes
+# `mfu(n_devices=mesh size)` correct on every generation: mesh axes
+# count devices, and the table is per-device. f32 is derived below as the
+# measured-practical MXU f32 ratio (~1/8 of bf16 via multi-pass
+# emulation on v4/v5 generations).
 _PEAKS_BF16 = {
-    "TPU v2": 22.5e12,   # per core x2? spec: 45 TFLOP/s per chip
-    "TPU v3": 61.5e12,   # per chip half of 123 board; device = 1 core
+    "TPU v2": 22.5e12,   # per core; chip spec 45 TFLOP/s, 2 cores/chip
+    "TPU v3": 61.5e12,   # per core; chip spec 123 TFLOP/s, 2 cores/chip
     "TPU v4": 275e12,
     "TPU v5 lite": 197e12,
     "TPU v5e": 197e12,
@@ -31,8 +39,11 @@ _PEAKS_BF16 = {
 }
 
 
-def chip_peak_flops(device=None, dtype: str = "bf16") -> float | None:
-    """Peak FLOP/s of one chip of `device` (default: jax.devices()[0]).
+def device_peak_flops(device=None, dtype: str = "bf16") -> float | None:
+    """Peak FLOP/s of one JAX device of `device`'s kind (default:
+    jax.devices()[0]). "Device" is a whole chip on v4+ and a single
+    TensorCore on v2/v3 (see _PEAKS_BF16) — the right denominator for
+    per-device throughput either way.
 
     Returns None when the device kind is unknown (CPU test meshes) —
     callers should then skip MFU reporting rather than invent a peak.
@@ -110,23 +121,28 @@ def transformer_flops_per_token(cfg, seq_len: int,
 
 
 def mfu(tokens_per_sec: float, cfg, seq_len: int,
-        dtype: str = "bf16", device=None, n_chips: int = 1,
+        dtype: str = "bf16", device=None, n_devices: int = 1,
         include_backward: bool = True) -> dict:
     """Achieved TFLOP/s and fraction-of-peak for a measured throughput.
 
-    `tokens_per_sec` is usually the GLOBAL rate; pass `n_chips` = the
-    number of chips producing it (the mesh size) so the denominator is
-    the fleet peak, not one chip's — otherwise a dp=4 run reports 4x its
-    true utilization. Returns {"tflops": achieved, "peak_tflops": fleet
-    peak or None, "mfu": fraction or None}. MFU is None off-TPU (unknown
-    peak)."""
+    `tokens_per_sec` is usually the GLOBAL rate; pass `n_devices` = the
+    number of JAX devices producing it (the mesh size — on v2/v3 that
+    counts TensorCores, matching the per-core table entries) so the
+    denominator is the fleet peak, not one device's — otherwise a dp=4
+    run reports 4x its true utilization. Returns {"tflops": achieved,
+    "peak_tflops": fleet peak or None, "mfu": fraction or None}. MFU is
+    None off-TPU (unknown peak)."""
     fpt = transformer_flops_per_token(cfg, seq_len, include_backward)
     achieved = tokens_per_sec * fpt
-    peak = chip_peak_flops(device, dtype)
+    peak = device_peak_flops(device, dtype)
     if peak is not None:
-        peak *= max(1, int(n_chips))
+        peak *= max(1, int(n_devices))
     return {
         "tflops": achieved / 1e12,
         "peak_tflops": None if peak is None else peak / 1e12,
         "mfu": None if peak is None else achieved / peak,
     }
+
+
+# Back-compat alias (pre-round-4 name; the table was always per-device)
+chip_peak_flops = device_peak_flops

@@ -1154,6 +1154,12 @@ class PipelineLMEngine:
         s_right = [(i, (i + 1) % pp) for i in range(pp)]
         assert self.tp == 1 and self.sp == 1, (
             "pipelined decode supports ('dp','pp') meshes (tp/sp size 1)")
+        assert self.vpp == 1, (
+            "pipelined decode needs plain stage layout (virtual_pp == 1): "
+            "with vpp > 1 the stacked blocks are interleave-permuted and "
+            "the single-hop-per-device phase chain would execute chunks "
+            "in device order, not logical-stage order — restore the "
+            "checkpoint into a vpp=1 pipeline to sample")
         assert not self.fsdp, (
             "pipelined decode needs stage-resident params; restore the "
             "checkpoint into a non-fsdp pipeline to sample")
@@ -1226,7 +1232,19 @@ class PipelineLMEngine:
                                          jnp.arange(pp))
             # after pp hops the final stage's output sits on stage 0
             logits = head(params_c, h[:, tp_len - 1])
+            # fold the dp coordinate in (dp>1 only — statically gated so
+            # dp=1 keeps the replicated path's exact key stream): each
+            # dp shard samples its LOCAL (B/dp, V) logit rows, so shards
+            # sharing a key would draw identical gumbel noise
+            # row-for-row (correlated streams). Sampled (temperature>0)
+            # streams therefore match the replicated models.generate
+            # path bit-exactly at dp=1 only (categorical derives
+            # per-row noise from the batch shape); greedy decode
+            # matches at any dp.
             rng0 = jax.random.PRNGKey(seed)
+            if self.dp > 1:
+                rng0 = jax.random.fold_in(rng0,
+                                          jax.lax.axis_index("dp"))
             tok0 = _sample(logits, jax.random.fold_in(rng0, 0),
                            temperature, top_k, top_p)
             tok0 = jax.lax.psum(jnp.where(s == 0, tok0, 0), "pp")
@@ -1278,7 +1296,12 @@ class PipelineLMEngine:
         """Sample `max_new` tokens after `prompt` (B, Tp) ON the
         pp-sharded params (no re-gather). Returns (B, max_new) int32.
         Token-stream-identical to `models.generate.generate` on the
-        canonical params (same sampling keys; asserted in tests)."""
+        canonical params (same sampling keys; asserted in tests) for
+        greedy decode at any dp and for sampled decode at dp=1; under
+        dp>1 sampled streams are independent per shard (the dp
+        coordinate is folded into the key) but not bit-equal to the
+        replicated path's, whose per-row noise depends on the full
+        batch shape."""
         b, tp_len = prompt.shape
         assert tp_len + max_new <= self.cfg.max_seq, (
             f"prompt {tp_len} + max_new {max_new} exceeds "

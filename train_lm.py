@@ -712,11 +712,11 @@ def train(args) -> float:
                     # engine's mesh size, not one chip's peak.
                     from shallowspeed_tpu.flops import mfu as _mfu
 
-                    n_chips = getattr(getattr(engine, "mesh", None),
-                                      "devices", np.zeros(1)).size
+                    n_dev = getattr(getattr(engine, "mesh", None),
+                                    "devices", np.zeros(1)).size
                     perf = _mfu(toks_s, cfg, args.seq_len,
                                 dtype="bf16" if args.bf16 else "f32",
-                                n_chips=n_chips)
+                                n_devices=n_dev)
                     mfu_txt = ("" if perf["mfu"] is None else
                                f"  {perf['tflops']:.1f} TF/s "
                                f"({perf['mfu'] * 100:.1f}% MFU)")
@@ -800,6 +800,7 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
         prompt = prompt[:1, :16]  # one row, short prefix
     if hasattr(engine, "generate") and getattr(engine, "tp", 1) == 1 \
             and getattr(engine, "sp", 1) == 1 \
+            and getattr(engine, "vpp", 1) == 1 \
             and not getattr(engine, "fsdp", False):
         # pipeline engine: decode ON the pp-sharded params (no re-gather
         # onto one device's memory); token-stream-identical to the
