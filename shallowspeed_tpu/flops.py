@@ -122,7 +122,7 @@ def transformer_flops_per_token(cfg, seq_len: int,
 
 def mfu(tokens_per_sec: float, cfg, seq_len: int,
         dtype: str = "bf16", device=None, n_devices: int = 1,
-        include_backward: bool = True) -> dict:
+        include_backward: bool = True, n_chips: int | None = None) -> dict:
     """Achieved TFLOP/s and fraction-of-peak for a measured throughput.
 
     `tokens_per_sec` is usually the GLOBAL rate; pass `n_devices` = the
@@ -132,6 +132,8 @@ def mfu(tokens_per_sec: float, cfg, seq_len: int,
     run reports 4x its true utilization. Returns {"tflops": achieved,
     "peak_tflops": fleet peak or None, "mfu": fraction or None}. MFU is
     None off-TPU (unknown peak)."""
+    if n_chips is not None:  # deprecated pre-round-4 keyword
+        n_devices = n_chips
     fpt = transformer_flops_per_token(cfg, seq_len, include_backward)
     achieved = tokens_per_sec * fpt
     peak = device_peak_flops(device, dtype)

@@ -261,65 +261,7 @@ def simulate_interleaved(num_micro_batches: int, pp: int,
     depth = pp * vpp
     logical = simulate(PipeDreamSchedule, n_mu, depth)
     plain = simulate(PipeDreamSchedule, n_mu, pp)
-
-    # per-logical-stage op streams in 1F1B order: ("F"|"B", mu)
-    def stream(stage):
-        ops = []
-        warm = min(depth - stage - 1, n_mu)
-        ops += [("F", m) for m in range(warm)]
-        for i in range(n_mu - warm):
-            ops += [("F", warm + i), ("B", i)]
-        ops += [("B", m) for m in range(n_mu - warm, n_mu)]
-        return ops
-
-    streams = {ls: stream(ls) for ls in range(depth)}
-    pos = {ls: 0 for ls in range(depth)}
-    f_done = {}                      # (ls, mu) -> completion round
-    b_done = {}
-    stash = [0] * pp                 # per-device in-flight forwards
-    peak = [0] * pp
-    rounds = 0
-    total_ops = sum(len(s) for s in streams.values())
-    done_ops = 0
-
-    def ready(ls, rnd):
-        if pos[ls] >= len(streams[ls]):
-            return False
-        op, mu = streams[ls][pos[ls]]
-        if op == "F":
-            return ls == 0 or f_done.get((ls - 1, mu), rnd) < rnd
-        return (f_done.get((ls, mu), rnd) < rnd
-                and (ls == depth - 1
-                     or b_done.get((ls + 1, mu), rnd) < rnd))
-
-    while done_ops < total_ops:
-        progressed = False
-        for d in range(pp):
-            cands = [ls for ls in range(d, depth, pp) if ready(ls, rounds)]
-            if not cands:
-                continue
-            # drain-first: backwards beat forwards; deeper chunks first
-            def prio(ls):
-                op, mu = streams[ls][pos[ls]]
-                return (0 if op == "B" else 1, -ls, mu)
-
-            ls = min(cands, key=prio)
-            op, mu = streams[ls][pos[ls]]
-            if op == "F":
-                f_done[(ls, mu)] = rounds
-                stash[d] += 1
-                peak[d] = max(peak[d], stash[d])
-            else:
-                b_done[(ls, mu)] = rounds
-                stash[d] -= 1
-            pos[ls] += 1
-            done_ops += 1
-            progressed = True
-        rounds += 1
-        if not progressed and done_ops < total_ops:
-            raise ScheduleError(
-                f"interleaved schedule wedged at round {rounds} "
-                f"(pp={pp}, vpp={vpp}, n_mu={n_mu})")
+    _, _, _, peak, rounds = _greedy_interleaved(n_mu, pp, vpp)
 
     return InterleavedReport(
         makespan=rounds,
@@ -327,3 +269,204 @@ def simulate_interleaved(num_micro_batches: int, pp: int,
         peak_stash=peak,
         logical=logical,
     )
+
+
+@dataclass
+class InterleavedTables:
+    """The greedy interleaved-1F1B schedule lowered to STATIC per-round
+    arrays a compiled `lax.scan` can follow (pipeline_lm's vpp x 1f1b
+    engine). Round semantics: each device executes at most ONE chunk op
+    (op[r, d]: 0 none, 1 F, 2 B) on chunk `chunk[r, d]`, microbatch
+    `mu[r, d]`; afterwards activations hop one step right and cotangents
+    one step left (both unconditional ppermutes), and each device writes
+    the arrival into `act_write`/`grad_write` (the trash slot — index ==
+    n_*_slots — absorbs rounds with no valid arrival, keeping the
+    program uniform). F reads its input from `act_read` and stashes it
+    at `stash_write`; B re-reads the stash at `stash_read` and its
+    incoming cotangent at `grad_read`. Slot indices come from greedy
+    interval coloring of message/stash lifetimes, so n_*_slots is the
+    measured peak concurrency, not a guess."""
+
+    n_rounds: int
+    n_act_slots: int
+    n_grad_slots: int
+    n_stash_slots: int
+    op: "object"          # all arrays: int32 (n_rounds, pp)
+    chunk: "object"
+    mu: "object"
+    act_read: "object"
+    act_write: "object"
+    grad_read: "object"
+    grad_write: "object"
+    stash_write: "object"
+    stash_read: "object"
+
+
+def _greedy_interleaved(n_mu: int, pp: int, vpp: int):
+    """The device-contention list scheduling `simulate_interleaved`
+    measures, with full per-op placement recorded: returns
+    (ops, f_round, b_round, peak, rounds) where
+    ops[(r, d)] = ("F"|"B", l, mu)."""
+    depth = pp * vpp
+
+    def stream(stage):
+        s_ops = []
+        warm = min(depth - stage - 1, n_mu)
+        s_ops += [("F", m) for m in range(warm)]
+        for i in range(n_mu - warm):
+            s_ops += [("F", warm + i), ("B", i)]
+        s_ops += [("B", m) for m in range(n_mu - warm, n_mu)]
+        return s_ops
+
+    streams = {ls: stream(ls) for ls in range(depth)}
+    pos = {ls: 0 for ls in range(depth)}
+    f_round, b_round = {}, {}
+    stash = [0] * pp
+    peak = [0] * pp
+    ops = {}
+    rounds = 0
+    total = sum(len(s) for s in streams.values())
+    done = 0
+
+    def ready(ls, rnd):
+        if pos[ls] >= len(streams[ls]):
+            return False
+        op, mu = streams[ls][pos[ls]]
+        if op == "F":
+            return ls == 0 or f_round.get((ls - 1, mu), rnd) < rnd
+        return (f_round.get((ls, mu), rnd) < rnd
+                and (ls == depth - 1
+                     or b_round.get((ls + 1, mu), rnd) < rnd))
+
+    while done < total:
+        progressed = False
+        for d in range(pp):
+            cands = [ls for ls in range(d, depth, pp) if ready(ls, rounds)]
+            if not cands:
+                continue
+
+            def prio(ls):
+                op, mu = streams[ls][pos[ls]]
+                return (0 if op == "B" else 1, -ls, mu)
+
+            ls = min(cands, key=prio)
+            op, mu = streams[ls][pos[ls]]
+            if op == "F":
+                f_round[(ls, mu)] = rounds
+                stash[d] += 1
+                peak[d] = max(peak[d], stash[d])
+            else:
+                b_round[(ls, mu)] = rounds
+                stash[d] -= 1
+            ops[(rounds, d)] = (op, ls, mu)
+            pos[ls] += 1
+            done += 1
+            progressed = True
+        rounds += 1
+        if not progressed and done < total:
+            raise ScheduleError(
+                f"interleaved schedule wedged at round {rounds} "
+                f"(pp={pp}, vpp={vpp}, n_mu={n_mu})")
+    return ops, f_round, b_round, peak, rounds
+
+
+def _color_intervals(items):
+    """items: list of (key, write_round, read_round). Greedy interval
+    coloring: two items share a slot iff the earlier one's read is <=
+    the later one's write (a slot read during round r may be rewritten
+    at the end of round r' >= r; writes and reads of one device never
+    collide within a round — one op per round). Returns ({key: slot},
+    n_slots)."""
+    slots_free_at = []     # per slot: round after which it is reusable
+    assign = {}
+    for key, w, r in sorted(items, key=lambda it: (it[1], it[2])):
+        for i, free in enumerate(slots_free_at):
+            if free <= w:
+                assign[key] = i
+                slots_free_at[i] = r
+                break
+        else:
+            assign[key] = len(slots_free_at)
+            slots_free_at.append(r)
+    return assign, len(slots_free_at)
+
+
+def interleaved_tables(num_micro_batches: int, pp: int,
+                       vpp: int) -> InterleavedTables:
+    """Lower the verified greedy interleaved-1F1B schedule to the static
+    per-round tables the compiled engine follows (see InterleavedTables).
+    The same scheduling core backs `simulate_interleaved`, so what the
+    engine executes IS what the simulator proves."""
+    import numpy as np
+
+    n_mu = num_micro_batches
+    depth = pp * vpp
+    ops, f_round, b_round, _peak, rounds = _greedy_interleaved(
+        n_mu, pp, vpp)
+
+    # ---- message lifetimes, per consumer device
+    act_msgs = [[] for _ in range(pp)]   # (key=(l+1, mu), write, read)
+    grad_msgs = [[] for _ in range(pp)]
+    for (ls, mu), r_p in f_round.items():
+        if ls == depth - 1:
+            continue                     # last logical stage: loss, no msg
+        r_c = f_round[(ls + 1, mu)]
+        act_msgs[(ls + 1) % pp].append(((ls + 1, mu), r_p, r_c))
+    for (ls, mu), r_p in b_round.items():
+        if ls == 0:
+            continue                     # stage 0's dx is discarded
+        r_c = b_round[(ls - 1, mu)]
+        grad_msgs[(ls - 1) % pp].append(((ls - 1, mu), r_p, r_c))
+    stash_items = [[] for _ in range(pp)]  # (key=(l, mu), F round, B round)
+    for (ls, mu), r_f in f_round.items():
+        stash_items[ls % pp].append(((ls, mu), r_f, b_round[(ls, mu)]))
+
+    act_assign, grad_assign, stash_assign = {}, {}, {}
+    n_act = n_grad = n_stash = 0
+    for d in range(pp):
+        a, na = _color_intervals(act_msgs[d])
+        g, ng = _color_intervals(grad_msgs[d])
+        st, ns = _color_intervals(stash_items[d])
+        act_assign.update(a)
+        grad_assign.update(g)
+        stash_assign.update(st)
+        n_act, n_grad, n_stash = (max(n_act, na), max(n_grad, ng),
+                                  max(n_stash, ns))
+
+    # ---- per-round tables (trash slot = n_*_slots)
+    op_t = np.zeros((rounds, pp), np.int32)
+    chunk_t = np.zeros((rounds, pp), np.int32)
+    mu_t = np.zeros((rounds, pp), np.int32)
+    act_r = np.full((rounds, pp), n_act, np.int32)
+    act_w = np.full((rounds, pp), n_act, np.int32)
+    grad_r = np.full((rounds, pp), n_grad, np.int32)
+    grad_w = np.full((rounds, pp), n_grad, np.int32)
+    stash_w = np.full((rounds, pp), n_stash, np.int32)
+    stash_r = np.full((rounds, pp), n_stash, np.int32)
+    for (r, d), (op, ls, mu) in ops.items():
+        v = ls // pp
+        assert ls % pp == d
+        op_t[r, d] = 1 if op == "F" else 2
+        chunk_t[r, d] = v
+        mu_t[r, d] = mu
+        if op == "F":
+            if ls > 0:
+                act_r[r, d] = act_assign[(ls, mu)]
+            stash_w[r, d] = stash_assign[(ls, mu)]
+            # the produced activation arrives at device (d+1) % pp at
+            # the END of this round; that device writes it to the
+            # message's colored slot
+            if ls < depth - 1:
+                act_w[r, (d + 1) % pp] = act_assign[(ls + 1, mu)]
+        else:
+            if ls < depth - 1:
+                grad_r[r, d] = grad_assign[(ls, mu)]
+            stash_r[r, d] = stash_assign[(ls, mu)]
+            if ls > 0:
+                grad_w[r, (d - 1) % pp] = grad_assign[(ls - 1, mu)]
+
+    return InterleavedTables(
+        n_rounds=rounds, n_act_slots=n_act, n_grad_slots=n_grad,
+        n_stash_slots=n_stash, op=op_t, chunk=chunk_t, mu=mu_t,
+        act_read=act_r, act_write=act_w, grad_read=grad_r,
+        grad_write=grad_w, stash_write=stash_w, stash_read=stash_r)

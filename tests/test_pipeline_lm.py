@@ -439,12 +439,83 @@ def test_virtual_pp_checkpoint_roundtrip(tmp_path):
 
 
 def test_virtual_pp_guards():
-    with pytest.raises(AssertionError, match="GPipe"):
-        PipelineLMEngine(CFG, SGD(0.1), pp_mesh(1, 2), virtual_pp=2,
-                         schedule="1f1b")
     with pytest.raises(AssertionError, match="divide over"):
         PipelineLMEngine(replace(CFG, n_layers=4), SGD(0.1),
                          pp_mesh(1, 2), virtual_pp=3)
+
+
+# ------------------------------ interleaved 1F1B (vpp x 1f1b, round 4)
+
+
+@pytest.mark.parametrize("dp,pp,vpp,n_mu", [(1, 2, 2, 4), (2, 2, 2, 2),
+                                            (1, 2, 2, 8), (1, 4, 2, 4)])
+def test_virtual_1f1b_matches_plain_dp(dp, pp, vpp, n_mu):
+    """Compiled interleaved PipeDream-Flush (table-driven rounds from
+    verify.interleaved_tables) must reproduce the serial trajectory —
+    the same oracle every other schedule answers to."""
+    cfg = replace(CFG, n_layers=pp * vpp)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    ref = ContextParallelEngine(cfg, SGD(0.1), mesh, seed=0)
+    eng = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(dp, pp),
+                           n_mubatches=n_mu, seed=0, schedule="1f1b",
+                           virtual_pp=vpp)
+    for step in range(3):
+        tok, tgt = batch(step)
+        lr_ = ref.train_batch(tok, tgt)
+        lp = eng.train_batch(tok, tgt)
+        assert lp == pytest.approx(lr_, rel=3e-4), (step, dp, pp, vpp)
+    for a, b in zip(jax.tree_util.tree_leaves(eng.get_canonical_params()),
+                    jax.tree_util.tree_leaves(ref.get_canonical_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_virtual_1f1b_matches_virtual_gpipe_with_dropout():
+    """The two interleaved schedules must draw BIT-IDENTICAL dropout
+    masks (same mu_key + chunk fold), so their loss trajectories agree
+    to reassociation tolerance even with dropout on."""
+    cfg = replace(CFG, dropout=0.1)
+    a = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(1, 2), n_mubatches=4,
+                         seed=0, schedule="gpipe", virtual_pp=2)
+    b = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(1, 2), n_mubatches=4,
+                         seed=0, schedule="1f1b", virtual_pp=2)
+    for step in range(2):
+        tok, tgt = batch(step)
+        la = a.train_batch(tok, tgt)
+        lb = b.train_batch(tok, tgt)
+        assert la == pytest.approx(lb, rel=3e-4), step
+
+
+def test_virtual_1f1b_moe():
+    """MoE x interleaved 1F1B: every chunk's balance/z aux rides the
+    per-round vjp seed (the GPipe-virtual path is the oracle)."""
+    cfg = replace(CFG, n_experts=2, moe_top_k=1, moe_aux_weight=1e-2)
+    a = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(1, 2), n_mubatches=2,
+                         seed=0, schedule="gpipe", virtual_pp=2)
+    b = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(1, 2), n_mubatches=2,
+                         seed=0, schedule="1f1b", virtual_pp=2)
+    for step in range(2):
+        tok, tgt = batch(step)
+        assert a.train_batch(tok, tgt) == pytest.approx(
+            b.train_batch(tok, tgt), rel=3e-4), step
+
+
+def test_virtual_1f1b_checkpoint_roundtrip(tmp_path):
+    """Interleave permutation invisible in the canonical checkpoint,
+    1F1B flavor: save interleaved-1f1b, restore plain gpipe."""
+    from shallowspeed_tpu import checkpoint
+
+    eng = PipelineLMEngine(CFG, Adam(1e-2), pp_mesh(1, 2),
+                           n_mubatches=2, seed=0, schedule="1f1b",
+                           virtual_pp=2)
+    tok, tgt = batch(3)
+    eng.train_batch(tok, tgt)
+    checkpoint.save(str(tmp_path), eng, 1)
+    eng2 = PipelineLMEngine(CFG, Adam(1e-2), pp_mesh(1, 4),
+                            n_mubatches=2, seed=1)
+    checkpoint.restore(eng2, checkpoint.latest(str(tmp_path)))
+    assert eng.eval_loss(tok, tgt) == pytest.approx(
+        eng2.eval_loss(tok, tgt), rel=1e-4)
 
 
 # --------------------------------------------- ZeRO-1 x pp (round 3)

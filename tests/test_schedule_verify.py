@@ -167,3 +167,65 @@ def test_interleaved_stash_bounded():
                             for ls in range(d, depth, pp))
         assert rep.peak_stash[d] <= logical_bound, (d, rep.peak_stash)
         assert rep.peak_stash[d] < n_mu * vpp  # not GPipe
+
+
+# ------------------------- interleaved 1F1B execution tables (round 4)
+
+
+@pytest.mark.parametrize("n_mu,pp,vpp", [(2, 2, 2), (4, 2, 2), (8, 2, 2),
+                                         (4, 4, 2), (8, 4, 2), (8, 2, 4),
+                                         (6, 3, 2), (1, 2, 2), (3, 2, 3)])
+def test_interleaved_tables_replay_exact(n_mu, pp, vpp):
+    """The static per-round tables the COMPILED vpp x 1f1b engine
+    follows (verify.interleaved_tables) are replayed here against pure
+    channel semantics: every F consumes exactly its predecessor logical
+    stage's activation for ITS microbatch, every B consumes its own
+    stashed input and the successor's cotangent, slot coloring never
+    clobbers a live value, and the round count equals the verified
+    greedy makespan. This is the bridge from `simulate_interleaved`'s
+    proof to what the engine executes."""
+    from shallowspeed_tpu.parallel.verify import (interleaved_tables,
+                                                  simulate_interleaved)
+
+    tb = interleaved_tables(n_mu, pp, vpp)
+    depth = pp * vpp
+    act = [[None] * (tb.n_act_slots + 1) for _ in range(pp)]
+    grad = [[None] * (tb.n_grad_slots + 1) for _ in range(pp)]
+    stash = [[None] * (tb.n_stash_slots + 1) for _ in range(pp)]
+    f_seen, b_seen = set(), set()
+    for r in range(tb.n_rounds):
+        out_act = [None] * pp
+        out_grad = [None] * pp
+        for d in range(pp):
+            op, v, m = tb.op[r, d], tb.chunk[r, d], tb.mu[r, d]
+            l = v * pp + d
+            if op == 1:
+                x = ("emb", m) if l == 0 else act[d][tb.act_read[r, d]]
+                if l > 0:
+                    assert x == ("act", l - 1, m), (r, d, l, m, x)
+                stash[d][tb.stash_write[r, d]] = ("stash", l, m)
+                f_seen.add((l, m))
+                if l < depth - 1:
+                    out_act[d] = ("act", l, m)
+            elif op == 2:
+                st = stash[d][tb.stash_read[r, d]]
+                assert st == ("stash", l, m), (r, d, l, m, st)
+                if l < depth - 1:
+                    g = grad[d][tb.grad_read[r, d]]
+                    assert g == ("grad", l + 1, m), (r, d, l, m, g)
+                b_seen.add((l, m))
+                stash[d][tb.stash_read[r, d]] = None
+                if l > 0:
+                    out_grad[d] = ("grad", l, m)
+        for d in range(pp):
+            if tb.act_write[r, d] != tb.n_act_slots:
+                a = out_act[(d - 1) % pp]
+                assert a is not None, (r, d)
+                act[d][tb.act_write[r, d]] = a
+            if tb.grad_write[r, d] != tb.n_grad_slots:
+                g = out_grad[(d + 1) % pp]
+                assert g is not None, (r, d)
+                grad[d][tb.grad_write[r, d]] = g
+    full = {(l, m) for l in range(depth) for m in range(n_mu)}
+    assert f_seen == full and b_seen == full
+    assert tb.n_rounds == simulate_interleaved(n_mu, pp, vpp).makespan
