@@ -131,6 +131,13 @@ def main():
                          "KV-cache decode")
     args = ap.parse_args()
     if args.pp:
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(args.pp, 2)}").strip()
         import jax
 
         jax.config.update("jax_platforms", "cpu")

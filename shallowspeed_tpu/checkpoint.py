@@ -108,10 +108,30 @@ def load_pytree(path, with_meta: bool = False):
 
 
 def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
-                extra: dict, opt_canon=None, canon_meta=None) -> Path:
+                extra: dict, opt_canon=None, canon_meta=None,
+                sync: bool = True) -> Path:
     """The one encoding of the on-disk layout + atomic rename, shared by
-    the synchronous and async save paths (they must never drift)."""
+    the synchronous and async save paths (they must never drift).
+
+    Multi-controller (round 4): the device->host fetch is COLLECTIVE
+    (every process replicates non-addressable leaves together —
+    `distributed.fetch_global`), then only process 0 touches the
+    filesystem, then a barrier releases the others — so a save at one
+    process topology restores at any other."""
+    from shallowspeed_tpu.distributed import (barrier, fetch_global,
+                                              process_zero)
+
+    # collective fetch first, identical order on every process
+    params = fetch_global(params)
+    opt_state = fetch_global(opt_state)
+    extra = {k: fetch_global(v) for k, v in sorted(extra.items())}
+    if opt_canon is not None:
+        opt_canon = fetch_global(opt_canon)
     final = Path(ckpt_dir) / f"ckpt_{epoch}"
+    if not process_zero():
+        if sync:
+            barrier(f"ckpt_{epoch}")
+        return final
     tmp = Path(ckpt_dir) / f"ckpt_{epoch}.tmp"
     if tmp.exists():
         shutil.rmtree(tmp)
@@ -125,6 +145,9 @@ def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
     if final.exists():
         shutil.rmtree(final)
     tmp.rename(final)
+    if sync:
+        # releases the other processes only once the rename landed
+        barrier(f"ckpt_{epoch}")
     return final
 
 
@@ -153,7 +176,9 @@ def _canon_opt_export(engine, host_opt_state=None):
     if export is None:
         return None, None
     if host_opt_state is None:
-        host_opt_state = jax.device_get(engine.opt_state)
+        from shallowspeed_tpu.distributed import fetch_global
+
+        host_opt_state = fetch_global(engine.opt_state)
     try:
         return opt.map_state_trees(host_opt_state, export), meta
     except ValueError:
@@ -198,9 +223,15 @@ def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
     `extra`: optional {filename-stem: pytree} written INSIDE the atomic
     rename (e.g. the driver's EMA weights) — a crash can never produce a
     checkpoint that `latest()` selects but whose side trees are missing."""
-    opt_canon, canon_meta = _canon_opt_export(engine)
+    from shallowspeed_tpu.distributed import fetch_global
+
+    # fetch the opt state ONCE (in a multi-controller run this is a
+    # collective all-gather sweep) and share the host copy between the
+    # canonical export and the on-disk engine-shaped record
+    host_opt = fetch_global(engine.opt_state)
+    opt_canon, canon_meta = _canon_opt_export(engine, host_opt)
     return _write_ckpt(
-        ckpt_dir, epoch, engine.get_canonical_params(), engine.opt_state,
+        ckpt_dir, epoch, engine.get_canonical_params(), host_opt,
         _opt_meta(engine, epoch), extra or {}, opt_canon, canon_meta)
 
 
@@ -249,24 +280,38 @@ class AsyncSaver:
     def save(self, ckpt_dir, engine, epoch: int,
              extra: dict | None = None) -> None:
         """Snapshot now, write later. The snapshot is a host copy, so
-        the engine may keep training (and donating buffers) immediately."""
+        the engine may keep training (and donating buffers) immediately.
+        The snapshot fetch runs on the CALLER's thread — in a
+        multi-controller run it is collective (fetch_global), and doing
+        it here (not on the writer thread) keeps every process's
+        collective order identical to its training stream."""
+        from shallowspeed_tpu.distributed import fetch_global
+
         self._raise_pending()
-        params = jax.device_get(engine.get_canonical_params())
-        opt_state = jax.device_get(engine.opt_state)
+        params = fetch_global(engine.get_canonical_params())
+        opt_state = fetch_global(engine.opt_state)
         opt_canon, canon_meta = _canon_opt_export(engine, opt_state)
-        extra_host = {k: jax.device_get(v)
-                      for k, v in (extra or {}).items()}
+        extra_host = {k: fetch_global(v)
+                      for k, v in sorted((extra or {}).items())}
         meta = _opt_meta(engine, epoch)
 
         def write():
+            # sync=False: no collectives on the writer thread (they
+            # would interleave with the training stream's); wait()
+            # barriers on the caller's thread instead
             _write_ckpt(ckpt_dir, epoch, params, opt_state, meta,
-                        extra_host, opt_canon, canon_meta)
+                        extra_host, opt_canon, canon_meta, sync=False)
 
         self._q.put(write)
 
     def wait(self) -> None:
-        """Block until every queued save is on disk; re-raise failures."""
+        """Block until every queued save is on disk; re-raise failures.
+        Multi-controller: also barriers, so after wait() every process
+        may trust `latest()`."""
+        from shallowspeed_tpu.distributed import barrier
+
         self._q.join()
+        barrier("async-ckpt-drain")
         self._raise_pending()
 
     def close(self) -> None:

@@ -30,11 +30,12 @@ multi-pod fleet:
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
 def initialize(coordinator_address: str | None = None,
@@ -189,3 +190,38 @@ def local_rows(arr: np.ndarray) -> np.ndarray:
     rows = arr.shape[0] // p
     i = jax.process_index()
     return arr[i * rows:(i + 1) * rows]
+
+
+def fetch_global(tree):
+    """`jax.device_get` that also works on MULTI-CONTROLLER globally
+    sharded pytrees (round 4 — the checkpoint path's fetch).
+
+    Single-process: plain device_get. Multi-process: a leaf sharded
+    over a mesh axis that spans processes is not fully addressable, so
+    device_get would raise; replicate every jax.Array leaf first (jit
+    identity with replicated out_shardings — XLA inserts the
+    all-gathers, riding ICI/DCN) and read the now-local full copy.
+    Collective: EVERY process must call this together (same order), the
+    same way they issue training steps."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+
+    def fetch(leaf):
+        if not isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        sh = getattr(leaf, "sharding", None)
+        if getattr(leaf, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(leaf))
+        rep = _replicator(NamedSharding(sh.mesh, PartitionSpec()))(leaf)
+        return np.asarray(jax.device_get(rep))
+
+    return jax.tree_util.tree_map(fetch, tree)
+
+
+@functools.lru_cache(maxsize=64)
+def _replicator(sharding: NamedSharding):
+    """Cached jitted identity-with-replication: jit caches on function
+    identity, so a fresh lambda per leaf would recompile the replicate
+    program on every checkpoint save — one program per target sharding
+    (per mesh) serves every leaf instead."""
+    return jax.jit(lambda x: x, out_shardings=sharding)

@@ -131,7 +131,7 @@ def router_z_loss(gate_logits: jax.Array) -> jax.Array:
 
 
 def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float,
-            priority: bool = False):
+            priority: bool = False, axis_name: str | None = None):
     """Mixture-of-experts feed-forward layer (drop-in for the dense GELU MLP).
 
     p: {"gate": (d, E), "wi": (E, d, ff), "bi": (E, ff),
@@ -145,9 +145,14 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float,
     The two routing einsums below are where expert parallelism happens: with
     `wi`/`wo` sharded `P('ep', ...)` and `x` sharded over batch, GSPMD turns
     the (G,S,·)->(E,G,C,·) layout change into an all-to-all over 'ep'.
-    """
+
+    `axis_name` (shard_map contexts only — see `moe_ffn_ep`): route the
+    dispatch/combine buffers through an EXPLICIT `lax.all_to_all` pair
+    over that mesh axis; `p` then holds this device's E/ep expert shard
+    while the gate stays global. One body serves both paths, so the
+    routing math cannot drift between them."""
     g, s, d = x.shape
-    e = p["gate"].shape[1]
+    e = p["gate"].shape[1]                     # GLOBAL expert count
     cap = expert_capacity(s, e, top_k, capacity_factor)
 
     # Router in f32 regardless of compute dtype: bf16 gate logits can flip
@@ -159,9 +164,55 @@ def moe_ffn(p: dict, x: jax.Array, top_k: int, capacity_factor: float,
         logits, cap, top_k, priority=priority)
 
     xin = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), x)
+    if axis_name is not None:
+        # (E, G, C, d) -> (E_local, ep*G, C, d): peer j receives every
+        # peer's rows [j*E_local, (j+1)*E_local) — matching the
+        # contiguous P(..., 'ep', ...) shard of the stacked expert
+        # weights — blocks ordered by source peer on the group axis
+        xin = jax.lax.all_to_all(xin, axis_name, split_axis=0,
+                                 concat_axis=1, tiled=True)
     h = jax.nn.gelu(jnp.einsum("egcd,edf->egcf", xin, p["wi"])
                     + p["bi"][:, None, None, :])
     out = (jnp.einsum("egcf,efd->egcd", h, p["wo"])
            + p["bo"][:, None, None, :])
+    if axis_name is not None:
+        # inverse: scatter the group axis back, gather the expert axis
+        out = jax.lax.all_to_all(out, axis_name, split_axis=1,
+                                 concat_axis=0, tiled=True)
     y = jnp.einsum("gsec,egcd->gsd", combine.astype(x.dtype), out)
     return y, aux, router_z_loss(logits), stats
+
+
+def moe_ffn_ep(p: dict, x: jax.Array, top_k: int, capacity_factor: float,
+               axis_name: str = "ep", priority: bool = False):
+    """`moe_ffn` for shard_map contexts — the expert parallelism is an
+    EXPLICIT `lax.all_to_all`, not a GSPMD placement decision (inside
+    shard_map there is no GSPMD to lower the resharding; same reason
+    `ulysses_attention` hand-writes its head<->sequence all-to-alls).
+
+    p: this device's expert shard — gate (d, E) REPLICATED over the ep
+    axis (every token routes over all E global experts), wi/bi/wo/bo
+    carrying only E/ep experts (leading dim E_local).
+    x: (G, S, d) — this device's LOCAL tokens (the ep axis shards rows,
+    multiplying dp for the data dimension).
+
+    Dispatch: route locally over global E, build the (E, G, C, d)
+    buffer, then all-to-all — scatter the expert axis, gather the group
+    axis — so each device holds (E_local, ep*G, C, d): its own experts'
+    slots from EVERY ep peer (the DeepSpeed-MoE / Tutel a2a pair,
+    ridden over ICI here). Expert FFN runs local; the inverse a2a
+    returns (E, G, C, d) and the combine einsum is local again.
+
+    The body IS `moe_ffn` (one shared implementation — the routing math
+    cannot drift between the GSPMD and explicit-collective paths):
+    capacity competition is per (group row, expert) and each row is its
+    own group, so resharding rows across dp x ep changes NOTHING about
+    who gets dropped — asserted by the dp-only parity tests.
+
+    Aux/z losses are means over LOCAL tokens; the caller owns the
+    pmean over the data axes (('dp', 'ep') in the pipeline engine)."""
+    e = p["gate"].shape[1]
+    e_loc = p["wi"].shape[0]
+    assert e % e_loc == 0, (e, e_loc)
+    return moe_ffn(p, x, top_k, capacity_factor, priority=priority,
+                   axis_name=axis_name)

@@ -147,9 +147,10 @@ class PipelineLMEngine:
                  virtual_pp: int = 1, zero1: bool = False,
                  zero2: bool = False, fsdp: bool = False):
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp"),
-                                   ("dp", "pp", "sp")), (
-            f"PipelineLMEngine expects a ('dp','pp'[,'tp'|'sp']) mesh, "
-            f"got {mesh.axis_names}")
+                                   ("dp", "pp", "sp"),
+                                   ("dp", "pp", "ep")), (
+            f"PipelineLMEngine expects a ('dp','pp'[,'tp'|'sp'|'ep']) "
+            f"mesh, got {mesh.axis_names}")
         assert schedule in ("gpipe", "1f1b"), schedule
         assert virtual_pp >= 1, virtual_pp
         assert attn in ("xla", "flash", "ring", "ring-flash",
@@ -161,8 +162,25 @@ class PipelineLMEngine:
         self.dp, self.pp = mesh.devices.shape[:2]
         self.has_tp = mesh.axis_names[2:] == ("tp",)
         self.has_sp = mesh.axis_names[2:] == ("sp",)
+        self.has_ep = mesh.axis_names[2:] == ("ep",)
         self.tp = mesh.devices.shape[2] if self.has_tp else 1
         self.sp = mesh.devices.shape[2] if self.has_sp else 1
+        self.ep = mesh.devices.shape[2] if self.has_ep else 1
+        if self.has_ep and self.ep > 1:
+            # ep x pp (round 4): expert weights shard over 'ep' inside
+            # each pipeline stage; tokens shard over ('dp','ep') and the
+            # stage-local dispatch is the explicit all-to-all pair
+            # (ops.moe.moe_ffn_ep — shard_map has no GSPMD to lower the
+            # resharding). The ep axis is a DATA axis for every
+            # non-expert parameter (grads reduce over dp AND ep).
+            assert cfg.n_experts > 0, (
+                "an 'ep' mesh axis needs n_experts > 0")
+            assert cfg.n_experts % self.ep == 0, (
+                f"n_experts={cfg.n_experts} must divide over "
+                f"ep={self.ep}")
+            assert attn in ("xla", "flash"), (
+                f"ep composes with the xla/flash attention substrates "
+                f"(sequence stays whole inside the stage), got {attn!r}")
         if self.has_sp and self.sp > 1:
             assert attn in ("ring", "ring-flash", "ulysses-flash"), (
                 f"sp>1 needs a sequence-parallel attention substrate "
@@ -191,9 +209,10 @@ class PipelineLMEngine:
             # verified greedy contention schedule as static per-round
             # tables (verify.interleaved_tables — round 4). Either way
             # chunk bodies must be collective-free:
-            assert not self.has_tp and self.sp == 1, (
+            assert not self.has_tp and self.sp == 1 and self.ep == 1, (
                 "virtual_pp needs collective-free chunk bodies "
-                "(no tp psum / sp ring inside a cond-gated chunk)")
+                "(no tp psum / sp ring / ep all-to-all inside a "
+                "cond-gated chunk)")
             assert cfg.n_layers % (self.pp * virtual_pp) == 0, (
                 f"n_layers={cfg.n_layers} must divide over "
                 f"pp*virtual_pp={self.pp * virtual_pp}")
@@ -212,9 +231,9 @@ class PipelineLMEngine:
                 "--zero1/--zero2/--fsdp shard over dp; need dp > 1")
         if zero2 or fsdp:
             assert not self.has_sp and not self.has_tp and \
-                virtual_pp == 1, (
+                not self.has_ep and virtual_pp == 1, (
                     "zero2/fsdp x pp support the plain ('dp','pp') mesh "
-                    "(no sp/tp axis, no virtual stages)")
+                    "(no sp/tp/ep axis, no virtual stages)")
         self.n_mu = n_mubatches
         self.l_local = cfg.n_layers // self.pp
         self.optimizer = optimizer
@@ -253,6 +272,16 @@ class PipelineLMEngine:
                            "ln2": ln, "up": col, "down": rowp}
             if cfg.ffn == "swiglu":
                 blocks_spec = {**blocks_spec, "gate": col}
+        elif self.has_ep and "moe" in host["blocks"]:
+            # expert leaves (stacked (L, E, ...)) additionally shard the
+            # expert axis over 'ep'; the router gate replicates over ep
+            # (every token routes over all E global experts). A dense
+            # model on an ep-size-1 mesh keeps the plain P('pp') specs
+            # (the ep axis is then purely a data axis).
+            blocks_spec = tree_map(lambda _: P("pp"), host["blocks"])
+            blocks_spec["moe"] = {
+                "gate": P("pp"), "wi": P("pp", "ep"), "bi": P("pp", "ep"),
+                "wo": P("pp", "ep"), "bo": P("pp", "ep")}
         else:
             blocks_spec = tree_map(lambda _: P("pp"), host["blocks"])
         self._pspecs = {
@@ -308,7 +337,8 @@ class PipelineLMEngine:
         # exactly the axes it varies on (VMA-aware global_norm); private
         # copy, caller's optimizer untouched
         opt = copy.copy(self.optimizer)
-        opt.clip_axes = ("pp", "tp") if self.has_tp else ("pp",)
+        opt.clip_axes = (("pp", "tp") if self.has_tp else
+                         ("pp", "ep") if self.has_ep else ("pp",))
         right = [(i, (i + 1) % pp) for i in range(pp)]
         heads_local = cfg.n_heads // self.tp
         kv_local = cfg.kv_heads // self.tp
@@ -401,12 +431,18 @@ class PipelineLMEngine:
             h = T._norm(blk["ln2"], x, cfg)
             aux = jnp.float32(0.0)
             if cfg.n_experts > 0:
-                from shallowspeed_tpu.ops.moe import moe_ffn
+                from shallowspeed_tpu.ops.moe import moe_ffn, moe_ffn_ep
 
-                y, bal, z, _ = moe_ffn(
-                    blk["moe"], h, cfg.moe_top_k,
-                    cfg.moe_capacity_factor,
-                    priority=cfg.moe_routing == "priority")
+                if self.has_ep and self.ep > 1:
+                    y, bal, z, _ = moe_ffn_ep(
+                        blk["moe"], h, cfg.moe_top_k,
+                        cfg.moe_capacity_factor, axis_name="ep",
+                        priority=cfg.moe_routing == "priority")
+                else:
+                    y, bal, z, _ = moe_ffn(
+                        blk["moe"], h, cfg.moe_top_k,
+                        cfg.moe_capacity_factor,
+                        priority=cfg.moe_routing == "priority")
                 aux = (cfg.moe_aux_weight * bal
                        + cfg.moe_z_weight * z).astype(jnp.float32)
                 return x + T._dropout(y, cfg.dropout, k_ffn), aux
@@ -458,20 +494,30 @@ class PipelineLMEngine:
                 body, (x, aux0), (blocks, keys))
             return x, aux
 
+        has_ep = self.has_ep and self.ep > 1
+
         def mu_key(base, m):
             """Per-(step, microbatch, dp-tile, stage) dropout key — the
             SAME derivation in the GPipe and 1F1B builds, so the two
-            schedules produce bit-identical masks (asserted in tests)."""
+            schedules produce bit-identical masks (asserted in tests).
+            With an ep axis the rows are ep-sharded too, so the ep
+            coordinate folds in (ep=1 keeps the exact legacy stream)."""
             if base is None:
                 return None, None
             k = jax.random.fold_in(
                 jax.random.fold_in(base, m), jax.lax.axis_index("dp"))
+            if has_ep:
+                k = jax.random.fold_in(k, jax.lax.axis_index("ep"))
             k_stage = jax.random.fold_in(k, jax.lax.axis_index("pp"))
             k_emb = jax.random.fold_in(k, pp)  # stage ids are < pp
             return k_stage, k_emb
 
         sp = self.sp
-        act_axes = (("pp", "dp", "sp") if self.has_sp else ("pp", "dp"))
+        act_axes = (("pp", "dp", "sp") if self.has_sp else
+                    ("pp", "dp", "ep") if self.has_ep else ("pp", "dp"))
+        # the mesh axes that shard DATA rows: loss partials pmean over
+        # these; non-expert grads reduce over them (plus 'pp' by spec)
+        data_axes = ("dp", "ep") if self.has_ep else ("dp",)
 
         def tile_pos(t_local):
             """GLOBAL positions of this device's sequence tile (sp shards
@@ -657,12 +703,13 @@ class PipelineLMEngine:
             (loss, _), grads = jax.value_and_grad(
                 local_loss, has_aux=True)(params, tokens, targets, key)
             # variance typing does the reductions: block grads arrive
-            # psum'd over dp (+sp) (params invariant there), embed/head
-            # grads psum'd over every mesh axis they're invariant on.
-            # The loss PARTIAL still needs its value reduction here.
+            # psum'd over dp (+sp/+ep) (params invariant there — expert
+            # leaves, ep-sharded, reduce over dp only), embed/head grads
+            # psum'd over every mesh axis they're invariant on. The loss
+            # PARTIAL still needs its value reduction here.
             loss = jax.lax.psum(loss,
                                 ("pp", "sp") if self.has_sp else "pp")
-            return jax.lax.pmean(loss, "dp"), grads
+            return jax.lax.pmean(loss, data_axes), grads
 
         # ------------------------------------------- 1F1B (PipeDream-Flush)
 
@@ -675,7 +722,8 @@ class PipelineLMEngine:
         # (ln/bias/embed/inter-stage dx get the Megatron per-microbatch
         # psum) and which are already tp-complete (head, behind the
         # activation psum)
-        vary_axes = ("dp", "pp", "sp") if self.has_sp else ("dp", "pp")
+        vary_axes = (("dp", "pp", "sp") if self.has_sp else
+                     ("dp", "pp", "ep") if self.has_ep else ("dp", "pp"))
 
         def _spec_axes(spec: P) -> set:
             used = set()
@@ -782,7 +830,10 @@ class PipelineLMEngine:
             path substitutes a dp reduce-scatter)."""
             s = jax.lax.axis_index("pp")
             is_last = s == pp - 1
-            uniform = self.has_sp  # see the collective-schedule note below
+            # sp ring hops AND ep all-to-alls live inside stage_fwd;
+            # either way the collective schedule must be identical on
+            # every device, so the F/B halves run unmasked (see below)
+            uniform = self.has_sp or has_ep
             # pvary the cast params to fully-varying BEFORE the vjp:
             # variance-typed autodiff would otherwise auto-psum each
             # invariant param's cotangent inside every B tick (a full
@@ -1101,9 +1152,11 @@ class PipelineLMEngine:
         pspecs, ospecs = self._pspecs, self._opt_specs
         use_1f1b = self.schedule == "1f1b"
         seed = self._seed
-        # data specs: microbatch axis unsharded, rows over dp, sequence
-        # over sp when the mesh has one
-        dspec = P(None, "dp", "sp") if self.has_sp else P(None, "dp")
+        # data specs: microbatch axis unsharded, rows over dp (and over
+        # ep when the mesh has one — ep multiplies the data dimension),
+        # sequence over sp when the mesh has one
+        dspec = (P(None, "dp", "sp") if self.has_sp else
+                 P(None, ("dp", "ep")) if self.has_ep else P(None, "dp"))
 
         def train_key(step):
             if cfg.dropout == 0.0:
@@ -1117,10 +1170,12 @@ class PipelineLMEngine:
             key = train_key(step)
             if use_1f1b:
                 loss, grads = local_1f1b(params, tokens, targets, key)
-                loss = jax.lax.pmean(loss, "dp")
+                loss = jax.lax.pmean(loss, data_axes)
             else:
                 loss, grads = grads_and_loss(params, tokens, targets, key)
-            grads = tree_map(lambda g: g / self.dp, grads)
+            # psum'd sums / shard count = mean over the dp (x ep) data
+            # tiles — equal-sized, so the mean is exact
+            grads = tree_map(lambda g: g / (self.dp * self.ep), grads)
             return loss, grads
 
         @partial(jax.jit, donate_argnums=(0, 1))
@@ -1154,7 +1209,7 @@ class PipelineLMEngine:
             loss, _ = loss_fn(params, tokens, targets, train=False)
             loss = jax.lax.psum(loss,
                                 ("pp", "sp") if self.has_sp else "pp")
-            return jax.lax.pmean(loss, "dp")
+            return jax.lax.pmean(loss, data_axes)
 
         if self.zero2 or self.fsdp:
             # ZeRO-2 x pp: grads leave the shard_map dp-SHARDED (one
@@ -1241,23 +1296,27 @@ class PipelineLMEngine:
 
     def _split_mu(self, arr: np.ndarray):
         b, t = arr.shape
-        assert b % (self.dp * self.n_mu) == 0, (
-            f"batch {b} must divide over dp={self.dp} x "
+        dshard = self.dp * self.ep   # row-sharding degree (ep is data)
+        assert b % (dshard * self.n_mu) == 0, (
+            f"batch {b} must divide over dp*ep={dshard} x "
             f"n_mubatches={self.n_mu}")
         assert t <= self.cfg.max_seq
         assert t % self.sp == 0, (
             f"sequence length {t} must divide over sp={self.sp}")
-        mubs = b // (self.dp * self.n_mu)
-        spec = (P(None, "dp", "sp") if self.has_sp else P(None, "dp"))
-        # (B, T) -> (n_mu, dp*mubs, T): microbatch-major so each dp shard
-        # of axis 1 holds rows of every microbatch. place_global (not a
-        # bare device_put) so multi-controller runs stitch each process's
-        # host-local piece into the global batch (distributed.py).
+        mubs = b // (dshard * self.n_mu)
+        spec = (P(None, "dp", "sp") if self.has_sp else
+                P(None, ("dp", "ep")) if self.has_ep else P(None, "dp"))
+        # (B, T) -> (n_mu, dp*ep*mubs, T): microbatch-major so each row
+        # shard of axis 1 holds rows of every microbatch (dp-major then
+        # ep, matching the P(('dp','ep')) tuple order). place_global
+        # (not a bare device_put) so multi-controller runs stitch each
+        # process's host-local piece into the global batch
+        # (distributed.py).
         from shallowspeed_tpu.distributed import place_global
 
         return place_global(
             np.ascontiguousarray(
-                arr.reshape(self.dp, self.n_mu, mubs, t)
+                arr.reshape(dshard, self.n_mu, mubs, t)
                 .transpose(1, 0, 2, 3).reshape(self.n_mu, -1, t)),
             NamedSharding(self.mesh, spec), local=False)
 
@@ -1321,8 +1380,11 @@ class PipelineLMEngine:
         cfg = self.cfg
         pp = self.pp
         s_right = [(i, (i + 1) % pp) for i in range(pp)]
-        assert self.tp == 1 and self.sp == 1, (
-            "pipelined decode supports ('dp','pp') meshes (tp/sp size 1)")
+        assert self.tp == 1 and self.sp == 1 and self.ep == 1, (
+            "pipelined decode supports ('dp','pp') meshes (tp/sp/ep "
+            "size 1; ep decode would need the all-to-all inside "
+            "cond-gated phases — restore into an ep=1 pipeline to "
+            "sample)")
         assert self.vpp == 1, (
             "pipelined decode needs plain stage layout (virtual_pp == 1): "
             "with vpp > 1 the stacked blocks are interleave-permuted and "
@@ -1357,13 +1419,18 @@ class PipelineLMEngine:
                                 is_leaf=lambda x: isinstance(x, P))
 
         @partial(shard_map, mesh=self.mesh,
-                 in_specs=(pspec_leaves, P("dp"), P()),
+                 in_specs=(pspec_leaves, P("dp"), P(), P()),
                  out_specs=P(None, "dp"))
-        def _gen(params, prompt, seed):
+        def _gen(params, prompt, tp_actual, seed):
             s = jax.lax.axis_index("pp")
             params_c = T.cast_params(params, cfg.compute_dtype)
             b = prompt.shape[0]
-            cshape = (l_local, b, cfg.max_seq, cfg.kv_heads, cfg.head_dim)
+            # cache sized to the generation (bucket + max_new), not
+            # max_seq; `tp_actual` is the traced true prompt length —
+            # pad-slot K/V is overwritten before the position mask can
+            # admit it (same argument as models.generate)
+            cshape = (l_local, b, tp_len + max_new, cfg.kv_heads,
+                      cfg.head_dim)
             # zeros are axis-invariant; the filled cache / hopped
             # activations vary over (pp, dp) — pvary so lax.cond
             # branches and scan carries type-match
@@ -1400,7 +1467,8 @@ class PipelineLMEngine:
             (h, cache), _ = jax.lax.scan(phase, (h0, cache),
                                          jnp.arange(pp))
             # after pp hops the final stage's output sits on stage 0
-            logits = head(params_c, h[:, tp_len - 1])
+            logits = head(params_c, jax.lax.dynamic_index_in_dim(
+                h, tp_actual - 1, 1, False))
             # fold the dp coordinate in (dp>1 only — statically gated so
             # dp=1 keeps the replicated path's exact key stream): each
             # dp shard samples its LOCAL (B/dp, V) logit rows, so shards
@@ -1421,7 +1489,7 @@ class PipelineLMEngine:
             # ---------------- decode loop (each token: pp phases)
             def dstep(carry, i):
                 tok_prev, cache = carry
-                pos = tp_len + i
+                pos = tp_actual + i
 
                 def work(h, cache):
                     x = jnp.where(s == 0,
@@ -1471,6 +1539,8 @@ class PipelineLMEngine:
         coordinate is folded into the key) but not bit-equal to the
         replicated path's, whose per-row noise depends on the full
         batch shape."""
+        from shallowspeed_tpu.models.generate import prompt_bucket_len
+
         b, tp_len = prompt.shape
         assert tp_len + max_new <= self.cfg.max_seq, (
             f"prompt {tp_len} + max_new {max_new} exceeds "
@@ -1479,16 +1549,21 @@ class PipelineLMEngine:
         if pad:  # dp shards batch rows; replicate the last row to fit
             prompt = np.concatenate(
                 [prompt, np.repeat(prompt[-1:], pad, axis=0)], axis=0)
-        key = (tp_len, max_new, temperature, top_k, top_p)
+        # compile-key on the 64-token prompt BUCKET (true length is a
+        # traced argument): same-bucket prompts share one executable
+        tp_b = prompt_bucket_len(tp_len, max_new, self.cfg.max_seq)
+        if tp_b != tp_len:
+            prompt = np.pad(prompt, ((0, 0), (0, tp_b - tp_len)))
+        key = (tp_b, max_new, temperature, top_k, top_p)
         cache = getattr(self, "_gen_cache", None)
         if cache is None or cache[0] != key:
             self._gen_cache = (key, self._build_generate(
-                tp_len, max_new, temperature, top_k, top_p))
+                tp_b, max_new, temperature, top_k, top_p))
         fn = self._gen_cache[1]
         out = fn(self.params,
                  jax.device_put(prompt.astype(np.int32),
                                 NamedSharding(self.mesh, P("dp"))),
-                 np.uint32(seed))
+                 jnp.int32(tp_len), np.uint32(seed))
         return np.asarray(jax.device_get(out)).T[:b]
 
     # -------------------------------------------- checkpoint interface
@@ -1507,8 +1582,13 @@ class PipelineLMEngine:
 
     def canon_export_tree(self, tree):
         """Params-shaped tree (e.g. Adam moments) -> canonical layout;
-        the SAME transform params take into a checkpoint."""
-        return unstack_blocks(self._unpermute(jax.device_get(tree)),
+        the SAME transform params take into a checkpoint. fetch_global,
+        not device_get: in a multi-controller run the pp/ep-sharded
+        leaves are not fully addressable (collective — every process
+        calls together, like a training step)."""
+        from shallowspeed_tpu.distributed import fetch_global
+
+        return unstack_blocks(self._unpermute(fetch_global(tree)),
                               self.cfg.n_layers)
 
     def canon_import_tree(self, tree):
@@ -1517,7 +1597,9 @@ class PipelineLMEngine:
         return self._permute(stack_blocks(tree_map(np.asarray, tree)))
 
     def get_canonical_params(self):
-        return unstack_blocks(self._unpermute(jax.device_get(self.params)),
+        from shallowspeed_tpu.distributed import fetch_global
+
+        return unstack_blocks(self._unpermute(fetch_global(self.params)),
                               self.cfg.n_layers)
 
     def set_canonical_params(self, params):

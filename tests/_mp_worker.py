@@ -126,6 +126,17 @@ def _pipeline_mode(pid: int, mode: str) -> None:
                                        schedule="gpipe", attn="ring")),
         ]
 
+    if mode == "pp":
+        # ZeRO-1 x pp across the boundary (round 4): Adam moments
+        # sharded over 'dp' (local devices) ON pp-sharded stages that
+        # SPAN the processes; the split GSPMD update program's
+        # all-gather crosses the boundary every step
+        from shallowspeed_tpu.optim import Adam
+
+        engines.append(("z1", PipelineLMEngine(cfg, Adam(1e-2), mesh,
+                                               n_mubatches=2, seed=0,
+                                               zero1=True)))
+
     for tag, eng in engines:
         for step in range(3):
             rng = np.random.default_rng([11, step])  # same on every proc
@@ -136,6 +147,22 @@ def _pipeline_mode(pid: int, mode: str) -> None:
         w = np.asarray(jax.device_get(eng.params["tok_emb"]))
         print(f"HASH {pid} {tag}:{hashlib.sha1(w.tobytes()).hexdigest()}",
               flush=True)
+
+    ckpt_dir = os.environ.get("MP_CKPT_DIR")
+    if ckpt_dir and mode == "pp":
+        # multi-controller checkpoint (round 4): the canonical fetch is
+        # collective (fetch_global replicates the pp-spanning leaves),
+        # only process 0 writes, the barrier releases the rest. The
+        # PARENT test then restores this 2-process checkpoint into a
+        # 1-process engine (save-at-A / restore-at-B).
+        from shallowspeed_tpu import checkpoint
+
+        z1 = engines[-1][1]
+        checkpoint.save(ckpt_dir, z1, 7)
+        rng = np.random.default_rng([11, 0])
+        tok = rng.integers(0, cfg.vocab, (8, 16)).astype(np.int32)
+        ev = z1.eval_loss(tok, np.roll(tok, -1, axis=1))
+        print(f"EVAL {pid} {ev!r}", flush=True)
     barrier("done")
     print(f"DONE {pid}", flush=True)
 

@@ -13,17 +13,22 @@ def expect_exit(argv, match):
         train(parse_args(argv))
 
 
-def test_pp_excludes_ep_and_guards_zero_dp():
-    # round 3: --sp, --experts, and the whole ZeRO family (--zero1/
-    # --zero2/--fsdp) now COMPOSE with --pp; only --ep doesn't
-    expect_exit(["--pp", "2", "--ep", "2", "--experts", "2"],
-                "--pp composes with --dp, --tp, --sp")
+def test_pp_ep_composes_and_guards_zero_dp():
+    # round 4: --ep now composes with --pp too (every model axis does);
+    # the remaining guards are the generic ones
+    expect_exit(["--pp", "2", "--ep", "2"], "--ep requires --experts")
+    expect_exit(["--pp", "2", "--ep", "2", "--experts", "2", "--tp", "2"],
+                "ONE extra model axis")
+    expect_exit(["--pp", "2", "--ep", "2", "--experts", "2",
+                 "--virtual-pp", "2"], "collective-free chunk")
     for z in ("--zero1", "--zero2", "--fsdp"):
         expect_exit(["--pp", "2", z],  # dp=1 has nothing to shard
                     "shards over\\s+dp")
     for z in ("--zero2", "--fsdp"):  # plain ('dp','pp') mesh only
         expect_exit(["--dp", "2", "--pp", "2", z, "--tp", "2"],
                     "plain")
+        expect_exit(["--dp", "2", "--pp", "2", z, "--ep", "2",
+                     "--experts", "2"], "plain")
 
 
 def test_pp_sp_guards():

@@ -171,14 +171,14 @@ def test_local_rows_multiprocess_slicing(monkeypatch):
         D.local_rows(arr)
 
 
-def _spawn_workers(mode, timeout=420):
+def _spawn_workers(mode, timeout=420, extra_env=None):
     import socket
 
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     worker = Path(__file__).parent / "_mp_worker.py"
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **(extra_env or {})}
     env.pop("JAX_COORDINATOR_ADDRESS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     procs = [subprocess.Popen(
@@ -204,12 +204,13 @@ def _parse(out, tag):
             if ln.startswith(tag)]
 
 
-def _reference_pipeline_losses(schedule, attn="xla", three_axis=False):
+def _reference_pipeline_losses(schedule, attn="xla", three_axis=False,
+                               zero1=False):
     """The SAME config/batches on a single-process mesh — multi-process
     runs must reproduce this trajectory (identical math, different
     transport)."""
     from shallowspeed_tpu.models.transformer import TransformerConfig
-    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.optim import SGD, Adam
     from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
 
     cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=2,
@@ -220,8 +221,9 @@ def _reference_pipeline_losses(schedule, attn="xla", three_axis=False):
     else:
         mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
                     ("dp", "pp"))
-    eng = PipelineLMEngine(cfg, SGD(0.1), mesh, n_mubatches=2, seed=0,
-                           schedule=schedule, attn=attn)
+    opt = Adam(1e-2) if zero1 else SGD(0.1)
+    eng = PipelineLMEngine(cfg, opt, mesh, n_mubatches=2, seed=0,
+                           schedule=schedule, attn=attn, zero1=zero1)
     losses = []
     for step in range(3):
         rng = np.random.default_rng([11, step])
@@ -230,23 +232,48 @@ def _reference_pipeline_losses(schedule, attn="xla", three_axis=False):
     return losses
 
 
-def test_two_process_pipeline_ppermute_crosses_boundary():
+def test_two_process_pipeline_ppermute_crosses_boundary(tmp_path):
     """(dp=2, pp=2) with the PP axis spanning two OS processes: every
     inter-stage ppermute hop (activations right, 1F1B cotangents left)
     is a REAL cross-process collective — the analogue of the reference's
-    inter-rank Send/Recv (`pipe.py:367-381`). Both schedules must
-    reproduce the single-process trajectory and keep replicas in sync."""
-    outs = _spawn_workers("pp")
+    inter-rank Send/Recv (`pipe.py:367-381`). Both schedules plus the
+    ZeRO-1 variant must reproduce the single-process trajectory and
+    keep replicas in sync; the 2-process multi-controller CHECKPOINT
+    (collective fetch, process-0 write) must restore into a 1-process
+    engine — save-at-process-count-A / restore-at-B (round 4)."""
+    outs = _spawn_workers("pp", extra_env={"MP_CKPT_DIR": str(tmp_path)})
     l0, l1 = (_parse(out, "LOSS") for out in outs)
-    assert len(l0) == 6 and l0 == l1, (l0, l1)
+    assert len(l0) == 9 and l0 == l1, (l0, l1)
     h0, h1 = (_parse(out, "HASH") for out in outs)
     assert h0 == h1, "weights diverged across processes"
     got = {tag_step: float(v) for (tag_step, v) in l0}
-    for sched in ("gpipe", "1f1b"):
-        ref = _reference_pipeline_losses(sched)
+    for sched, z1 in (("gpipe", False), ("1f1b", False), ("z1", True)):
+        ref = _reference_pipeline_losses("gpipe" if z1 else sched,
+                                         zero1=z1)
         for step, r in enumerate(ref):
             assert got[f"{sched}:{step}"] == pytest.approx(r, rel=1e-4), (
                 sched, step)
+
+    # restore the 2-process checkpoint at process count 1 (and a
+    # different layout: dp=1, pp=2, no zero1) — canonical format +
+    # canonical Adam moment record make it exact
+    from shallowspeed_tpu import checkpoint
+    from shallowspeed_tpu.models.transformer import TransformerConfig
+    from shallowspeed_tpu.optim import Adam
+    from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+
+    (ev0,), (ev1,) = (_parse(out, "EVAL") for out in outs)
+    assert ev0 == ev1
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, n_layers=2,
+                            max_seq=16)
+    eng = PipelineLMEngine(cfg, Adam(1e-2),
+                           Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                                ("dp", "pp")), n_mubatches=2, seed=5)
+    assert checkpoint.restore(eng, checkpoint.latest(str(tmp_path))) == 8
+    rng = np.random.default_rng([11, 0])
+    tok = rng.integers(0, cfg.vocab, (8, 16)).astype(np.int32)
+    ev = eng.eval_loss(tok, np.roll(tok, -1, axis=1))
+    assert ev == pytest.approx(float(ev0[0]), rel=1e-4)
 
 
 def test_two_process_ring_attention_crosses_boundary():

@@ -180,3 +180,82 @@ def test_auto_resume_mlp_driver(tmp_path):
                         text=True, cwd=repo, timeout=300)
     assert r2.returncode == 0, r2.stdout + r2.stderr
     assert "resumed from" in r2.stdout
+
+
+# ----------------------------------------------- gang mode (round 4)
+
+
+def _gang_child(tmp_path, body):
+    """A stub gang member: asserts the injected env, then runs `body`."""
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys, time
+        assert os.environ["JAX_COORDINATOR_ADDRESS"]
+        n = int(os.environ["JAX_NUM_PROCESSES"])
+        pid = int(os.environ["JAX_PROCESS_ID"])
+        {body}
+    """))
+    return [sys.executable, str(script)]
+
+
+def test_gang_env_injection_and_clean_finish(tmp_path):
+    from shallowspeed_tpu.elastic import GangSupervisor
+
+    cmd = _gang_child(tmp_path, """
+        (open(os.path.join(r'%s', f'saw_{pid}'), 'w')).write('1')
+        assert n == 2
+    """ % tmp_path)
+    sup = GangSupervisor(cmd, 2, RestartPolicy(max_restarts=0),
+                         poll_interval=0.05)
+    assert sup.run() == 0
+    assert (tmp_path / "saw_0").exists() and (tmp_path / "saw_1").exists()
+
+
+def test_gang_member_failure_restarts_whole_gang(tmp_path):
+    """Any member's nonzero exit kills the gang; the restart relaunches
+    ALL members (a JAX multi-controller job cannot continue with a
+    missing peer — the compiled collectives bake the topology)."""
+    from shallowspeed_tpu.elastic import GangSupervisor
+
+    marker = tmp_path / "crashed_once"
+    cmd = _gang_child(tmp_path, """
+        import pathlib
+        runs = pathlib.Path(r'%s') / f'runs_{pid}'
+        runs.write_text(str(int(runs.read_text()) + 1
+                            if runs.exists() else 1))
+        if pid == 1 and not pathlib.Path(r'%s').exists():
+            pathlib.Path(r'%s').write_text('x')
+            sys.exit(3)     # member 1 dies on the first attempt
+        time.sleep(0.3)     # member 0 would outlive member 1's crash
+    """ % (tmp_path, marker, marker))
+    sup = GangSupervisor(cmd, 2,
+                         RestartPolicy(max_restarts=2, backoff=0.01),
+                         poll_interval=0.05)
+    assert sup.run() == 0
+    # BOTH members ran twice: the healthy member was killed and
+    # relaunched along with the crashed one
+    assert (tmp_path / "runs_0").read_text() == "2"
+    assert (tmp_path / "runs_1").read_text() == "2"
+
+
+def test_gang_hang_kills_and_restarts(tmp_path):
+    """A single stale heartbeat (one wedged member) takes the whole
+    gang down; the restart succeeds."""
+    from shallowspeed_tpu.elastic import GangSupervisor
+
+    marker = tmp_path / "hung_once"
+    cmd = _gang_child(tmp_path, """
+        import pathlib
+        hb = sys.argv[sys.argv.index('--heartbeat-file') + 1]
+        if pid == 0 and not pathlib.Path(r'%s').exists():
+            pathlib.Path(r'%s').write_text('x')
+            time.sleep(120)  # wedged: never beats
+        for _ in range(8):
+            pathlib.Path(hb).touch(); time.sleep(0.2)
+    """ % (marker, marker))
+    sup = GangSupervisor(cmd, 2,
+                         RestartPolicy(max_restarts=2, backoff=0.01),
+                         hang_timeout=6.0, poll_interval=0.1)
+    t0 = time.time()
+    assert sup.run() == 0
+    assert time.time() - t0 < 60

@@ -311,3 +311,76 @@ def test_pipeline_generate_vpp_guard():
                               toks(1, b=1, t=8), cfg, 4,
                               temperature=0.0))
     assert out.shape == (1, 4)
+
+
+# --------------------------- prompt bucketing / cache sizing (round 4)
+
+
+def test_prompt_bucket_no_retrace(monkeypatch):
+    """Compile hygiene: prompts of DIFFERENT lengths within one 64-token
+    bucket share one executable (the true length is traced, not
+    baked) — previously every (prompt_len, max_new, sampler) tuple
+    recompiled. Streams must stay exact: the bucketed result equals
+    decoding the same prompt under a different same-bucket length
+    context, and greedy continuation of a longer prompt that shares a
+    prefix diverges only where the prompts do."""
+    import shallowspeed_tpu.models.generate as G
+
+    calls = {"n": 0}
+    real = G.prefill
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(G, "prefill", counting)
+    params = jax.device_put(T.init(CFG, seed=0))
+    outs = {}
+    for tp in (5, 9, 23):  # all in the 64-bucket
+        outs[tp] = np.asarray(G.generate(params, toks(seed=2, t=tp),
+                                         CFG, 8, temperature=0.0))
+        assert outs[tp].shape == (2, 8)
+    # <= 1: another test may have warmed the jit cache for this exact
+    # (bucket, max_new, sampler) signature already — 0 traces then
+    assert calls["n"] <= 1, (
+        f"prefill traced {calls['n']} times across same-bucket prompt "
+        f"lengths — the bucket is not sharing executables")
+
+
+def test_bucketed_stream_matches_exact_length():
+    """The pad-and-trace path must be a pure compile-strategy change:
+    the public (bucketed) generate's tokens equal a direct
+    `_generate_padded` call with NO padding (tp_b == tp, cache sized
+    tp+max_new) — greedy and sampled."""
+    import jax.numpy as _jnp
+
+    from shallowspeed_tpu.models.generate import _generate_padded
+
+    cfg = replace(CFG, max_seq=32)
+    params = jax.device_put(T.init(cfg, seed=0))
+    tp = 11
+    prompt = toks(seed=4, t=tp, vocab=cfg.vocab)
+    # public path pads 11 -> bucket capped at max_seq - max_new = 24
+    for kwargs in ({"temperature": 0.0},
+                   {"temperature": 1.0, "top_k": 8, "seed": 3}):
+        out_pub = np.asarray(generate(params, prompt, cfg, 8, **kwargs))
+        out_raw = np.asarray(_generate_padded(
+            params, jax.numpy.asarray(prompt), _jnp.int32(tp), cfg, 8,
+            kwargs.get("temperature", 0.0), kwargs.get("top_k", 0),
+            0.0, kwargs.get("seed", 0), cache_len=tp + 8))
+        np.testing.assert_array_equal(out_pub, out_raw), kwargs
+
+
+def test_kv_cache_sized_to_generation():
+    """init_kv_cache takes the sized length; generate's cache never
+    exceeds bucket + max_new slots even when max_seq is huge."""
+    from shallowspeed_tpu.models.generate import (init_kv_cache,
+                                                  prompt_bucket_len)
+
+    cfg = replace(CFG, max_seq=4096)
+    cache = init_kv_cache(cfg, 2, cache_len=96)
+    assert cache[0]["k"].shape[1] == 96
+    assert prompt_bucket_len(5, 32, 4096) == 64
+    assert prompt_bucket_len(65, 32, 4096) == 128
+    assert prompt_bucket_len(5, 4090, 4096) == 6   # capped by max_seq
+    assert prompt_bucket_len(64, 32, 4096) == 64   # exact bucket edge

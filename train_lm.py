@@ -325,6 +325,13 @@ def make_batch(args, vocab, step: int, text_data=None):
 
 def train(args) -> float:
     import jax
+
+    # multi-host: connect to the JAX distributed service when a
+    # coordinator is configured (env vars / pod metadata; the gang
+    # supervisor injects them) — single-process no-op, like train.py
+    from shallowspeed_tpu import distributed
+
+    distributed.initialize()
     from jax.sharding import Mesh
 
     from shallowspeed_tpu import checkpoint
@@ -346,23 +353,25 @@ def train(args) -> float:
                          f"token prompt exceeds --seq-len {args.seq_len} "
                          f"(= max_seq)")
     composite = args.sp > 1 and args.tp > 1
-    if args.pp > 1 and args.ep > 1:
-        raise SystemExit("--pp composes with --dp, --tp, --sp, "
-                         "--experts, --zero1/--zero2, and --fsdp "
-                         "(not --ep)")
     if args.pp > 1 and (args.zero1 or args.zero2 or args.fsdp) \
             and args.dp < 2:
         raise SystemExit("--pp with --zero1/--zero2/--fsdp shards over "
                          "dp; need --dp >= 2")
     if args.pp > 1 and (args.zero2 or args.fsdp) \
-            and (args.sp > 1 or args.tp > 1):
+            and (args.sp > 1 or args.tp > 1 or args.ep > 1):
         raise SystemExit("--pp with --zero2/--fsdp takes the plain "
-                         "('dp','pp') mesh (no --sp/--tp)")
-    if args.pp > 1 and args.sp > 1 and args.tp > 1:
-        raise SystemExit("--pp takes ONE extra model axis: --tp or --sp")
+                         "('dp','pp') mesh (no --sp/--tp/--ep)")
+    if args.pp > 1 and sum(a > 1 for a in (args.tp, args.sp,
+                                           args.ep)) > 1:
+        raise SystemExit("--pp takes ONE extra model axis: --tp, --sp, "
+                         "or --ep")
+    if args.pp > 1 and args.virtual_pp > 1 and args.ep > 1:
+        raise SystemExit("--virtual-pp needs collective-free chunk "
+                         "bodies (no --ep all-to-all inside a "
+                         "cond-gated chunk)")
     if args.pp > 1 and args.experts and args.tp > 1:
-        raise SystemExit("--experts with --pp composes with --dp/--sp "
-                         "(not --tp)")
+        raise SystemExit("--experts with --pp composes with --dp/--sp/"
+                         "--ep (not --tp)")
     if args.pp > 1 and args.sp > 1 and args.attn not in (
             "ring", "ring-flash", "ulysses-flash"):
         raise SystemExit(f"--pp with --sp needs a sequence-parallel "
@@ -418,7 +427,7 @@ def train(args) -> float:
     if composite:
         model_par = args.sp * args.tp
     elif args.pp > 1:
-        model_par = args.pp * args.tp * args.sp
+        model_par = args.pp * args.tp * args.sp * args.ep
     elif (args.ep > 1 or args.experts) and args.sp > 1:
         model_par = args.sp * args.ep  # long-context MoE: (dp, sp, ep)
     else:
@@ -491,6 +500,13 @@ def train(args) -> float:
             mesh = Mesh(devs.reshape(args.dp, args.pp, args.sp),
                         ("dp", "pp", "sp"))
             pp_attn = args.attn  # ring / ring-flash / ulysses-flash
+        elif args.ep > 1:
+            # ep x pp: experts sharded over 'ep' inside each stage,
+            # stage-local all-to-all dispatch; ep also multiplies the
+            # data dimension (rows shard over dp x ep)
+            mesh = Mesh(devs.reshape(args.dp, args.pp, args.ep),
+                        ("dp", "pp", "ep"))
+            pp_attn = "flash" if args.attn == "flash" else "xla"
         else:
             mesh = Mesh(devs.reshape(args.dp, args.pp), ("dp", "pp"))
             pp_attn = "flash" if args.attn == "flash" else "xla"
@@ -800,6 +816,7 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
         prompt = prompt[:1, :16]  # one row, short prefix
     if hasattr(engine, "generate") and getattr(engine, "tp", 1) == 1 \
             and getattr(engine, "sp", 1) == 1 \
+            and getattr(engine, "ep", 1) == 1 \
             and getattr(engine, "vpp", 1) == 1 \
             and not getattr(engine, "fsdp", False):
         # pipeline engine: decode ON the pp-sharded params (no re-gather
