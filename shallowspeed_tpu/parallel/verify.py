@@ -470,3 +470,128 @@ def interleaved_tables(num_micro_batches: int, pp: int,
         n_stash_slots=n_stash, op=op_t, chunk=chunk_t, mu=mu_t,
         act_read=act_r, act_write=act_w, grad_read=grad_r,
         grad_write=grad_w, stash_write=stash_w, stash_read=stash_r)
+
+
+# ------------------------------------------------- zero-bubble (ZB-H1)
+
+
+@dataclass
+class ZBReport:
+    """Zero-bubble-H1 vs 1F1B, costed device-level list scheduling."""
+
+    makespan: int          # ZB-H1 rounds (F=1, B=1, W=1)
+    f1b1_makespan: int     # plain 1F1B rounds (F=1, full backward=2)
+    bubble: int            # ZB idle rounds inside the busy window, worst device
+    f1b1_bubble: int
+    peak_stash: list       # per-device peak (act stashes + W-pending stashes)
+
+
+def simulate_zb(num_micro_batches: int, pp: int) -> ZBReport:
+    """ZB-H1 (Qi et al., "Zero Bubble Pipeline Parallelism"):
+    the backward splits into B (activation cotangent, needed by the
+    UPSTREAM stage — on the critical path) and W (weight gradients,
+    needed only by this stage's optimizer step — deferrable). Filling
+    pipeline bubbles with deferred W work removes most of 1F1B's drain
+    bubble at equal total compute.
+
+    Cost model: F = 1 round, B = 1, W = 1 (the full backward = B + W =
+    2, matching the 1F1B comparison where the fused backward costs 2
+    rounds). Dependencies: F(l,m) after F(l-1,m); B(l,m) after F(l,m)
+    and B(l+1,m); W(l,m) after B(l,m), all before the stage's
+    OptimizerStep (= end of batch here). Greedy device-level list
+    scheduling with the ZB-H1 priority B > F > W (W only fills holes);
+    both schedules run through the SAME scheduler so the comparison is
+    cost-for-cost.
+
+    Returns makespans, per-device busy-window bubbles, and the measured
+    peak stash: F->B activation stashes plus B->W pending-cotangent
+    stashes (ZB trades the smaller 1F1B stash for bubble removal —
+    the memory cost is reported, not hidden)."""
+    n_mu = num_micro_batches
+
+    def run(split_bw: bool):
+        # op = ("F"|"B"|"W", l, m); done round recorded at COMPLETION
+        cost = {"F": 1, "B": 2, "W": 0}
+        if split_bw:
+            cost = {"F": 1, "B": 1, "W": 1}
+        done = {}
+        pending = set()
+        for l in range(pp):
+            for m in range(n_mu):
+                pending.add(("F", l, m))
+                pending.add(("B", l, m))
+                if split_bw:
+                    pending.add(("W", l, m))
+        busy_until = [0] * pp
+        first_busy = [None] * pp
+        work_rounds = [0] * pp
+        stash = [0] * pp
+        peak = [0] * pp
+        rounds = 0
+
+        def ready(op, rnd):
+            kind, l, m = op
+            if kind == "F":
+                return l == 0 or done.get(("F", l - 1, m), rnd) < rnd
+            if kind == "B":
+                if ("F", l, m) not in done or done[("F", l, m)] >= rnd:
+                    return False
+                return l == pp - 1 or done.get(("B", l + 1, m),
+                                               rnd) < rnd
+            return ("B", l, m) in done and done[("B", l, m)] < rnd
+
+        while pending:
+            progressed = False
+            for d in range(pp):
+                if busy_until[d] > rounds:
+                    continue
+                cands = [op for op in pending
+                         if op[1] == d and ready(op, rounds)]
+                if not cands:
+                    continue
+                # ZB-H1 priority: B first (critical path), W fills
+                # holes — EXCEPT when the stash has reached the 1F1B
+                # bound, where W jumps ahead of F so memory stays at
+                # 1F1B's level (the paper's H1 memory contract)
+                if split_bw and stash[d] >= min(pp, n_mu):
+                    prio = {"B": 0, "W": 1, "F": 2}
+                else:
+                    prio = {"B": 0, "F": 1, "W": 2}
+                op = min(cands, key=lambda o: (prio[o[0]], -o[1], o[2]))
+                kind, l, m = op
+                c = cost[kind]
+                busy_until[d] = rounds + c
+                done[op] = rounds + c - 1
+                pending.discard(op)
+                if first_busy[d] is None:
+                    first_busy[d] = rounds
+                work_rounds[d] += c
+                if kind == "F":
+                    stash[d] += 1          # activation stash F -> B
+                elif kind == "B":
+                    if split_bw:
+                        stash[d] += 1      # cotangent stash B -> W
+                        stash[d] -= 1      # activation stash released
+                    else:
+                        stash[d] -= 1
+                else:
+                    stash[d] -= 1          # W consumes its stash
+                peak[d] = max(peak[d], stash[d])
+                progressed = True
+            rounds += 1
+            if not progressed and pending and \
+                    all(busy_until[d] <= rounds - 1 for d in range(pp)):
+                raise ScheduleError(
+                    f"zero-bubble schedule wedged (pp={pp}, "
+                    f"n_mu={n_mu}, split={split_bw})")
+        makespan = max(done[op] for op in done) + 1
+        bubble = max(
+            (makespan - (first_busy[d] or 0)) - work_rounds[d]
+            for d in range(pp))
+        return makespan, bubble, peak
+
+    zb_makespan, zb_bubble, zb_peak = run(True)
+    f_makespan, f_bubble, _ = run(False)
+    return ZBReport(makespan=zb_makespan, f1b1_makespan=f_makespan,
+                    bubble=zb_bubble, f1b1_bubble=f_bubble,
+                    peak_stash=zb_peak)

