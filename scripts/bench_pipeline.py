@@ -26,17 +26,34 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import numpy as np  # noqa: E402
 
+def _wanted_devices() -> int:
+    """Pre-scan argv for --pp/--sp so the forced CPU device pool is big
+    enough for the requested mesh (flags must land before jax imports)."""
+    import re as _re
+
+    vals = {"--pp": 2, "--sp": 1}
+    argv = sys.argv
+    for i, a in enumerate(argv):
+        for k in vals:
+            if a == k and i + 1 < len(argv):
+                vals[k] = max(1, int(argv[i + 1]))
+            elif _re.fullmatch(_re.escape(k) + r"=(\d+)", a):
+                vals[k] = max(1, int(a.split("=", 1)[1]))
+    return max(8, vals["--pp"] * vals["--sp"])
+
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+        flags + f" --xla_force_host_platform_device_count="
+        f"{_wanted_devices()}").strip()
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 
-def bench_engine(schedule, args, virtual_pp=1):
+def bench_engine(schedule, args, virtual_pp=1, sp=1):
     from jax.sharding import Mesh
 
     from shallowspeed_tpu.models.transformer import TransformerConfig
@@ -48,11 +65,18 @@ def bench_engine(schedule, args, virtual_pp=1):
         n_layers=args.n_layers, max_seq=args.seq_len, dtype=np.float32,
         compute_dtype=np.dtype("bfloat16"), rope=True, norm="rmsnorm",
         ffn="swiglu")
-    devs = np.array(jax.devices()[: args.pp]).reshape(1, args.pp)
-    mesh = Mesh(devs, ("dp", "pp"))
+    if sp > 1:
+        devs = np.array(jax.devices()[: args.pp * sp]).reshape(
+            1, args.pp, sp)
+        mesh = Mesh(devs, ("dp", "pp", "sp"))
+        attn = "ring"
+    else:
+        devs = np.array(jax.devices()[: args.pp]).reshape(1, args.pp)
+        mesh = Mesh(devs, ("dp", "pp"))
+        attn = "flash"
     eng = PipelineLMEngine(cfg, AdamW(3e-4), mesh,
                            n_mubatches=args.n_mu, seed=0,
-                           schedule=schedule, attn="flash",
+                           schedule=schedule, attn=attn,
                            virtual_pp=virtual_pp)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, cfg.vocab,
@@ -81,6 +105,10 @@ def main():
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--sp", type=int, default=0,
+                    help="also benchmark gpipe vs 1f1b on a (dp, pp, sp) "
+                         "mesh (ring attention; measures the 1F1B "
+                         "uniform-execution cost; 0 = skip)")
     ap.add_argument("--virtual-pp", type=int, default=2,
                     help="also benchmark interleaved virtual stages at "
                          "this chunk count (0/1 = skip)")
@@ -102,6 +130,20 @@ def main():
         inter = bench_engine("gpipe", args, virtual_pp=args.virtual_pp)
         out["interleaved_tokens_per_sec"] = round(inter, 0)
         out["interleaved_over_gpipe"] = round(inter / gpipe, 3)
+        interf = bench_engine("1f1b", args, virtual_pp=args.virtual_pp)
+        out["interleaved_1f1b_tokens_per_sec"] = round(interf, 0)
+        out["interleaved_1f1b_over_gpipe"] = round(interf / gpipe, 3)
+    if args.sp > 1:
+        # the 1F1B x sp uniform-execution cost (VERDICT r3 weak 4): with
+        # an sp axis every 1F1B tick runs BOTH halves unmasked (the
+        # cond-gated collective hazard), so its economics flip — this
+        # row measures by how much, against gpipe on the SAME sp mesh
+        gp_sp = bench_engine("gpipe", args, sp=args.sp)
+        f1_sp = bench_engine("1f1b", args, sp=args.sp)
+        out["sp"] = args.sp
+        out["sp_gpipe_tokens_per_sec"] = round(gp_sp, 0)
+        out["sp_1f1b_tokens_per_sec"] = round(f1_sp, 0)
+        out["sp_1f1b_over_gpipe"] = round(f1_sp / gp_sp, 3)
     print(json.dumps(out))
 
 

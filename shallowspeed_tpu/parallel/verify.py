@@ -557,7 +557,7 @@ def simulate_zb(num_micro_batches: int, pp: int) -> ZBReport:
                     prio = {"B": 0, "W": 1, "F": 2}
                 else:
                     prio = {"B": 0, "F": 1, "W": 2}
-                op = min(cands, key=lambda o: (prio[o[0]], -o[1], o[2]))
+                op = min(cands, key=lambda o: (prio[o[0]], o[2]))
                 kind, l, m = op
                 c = cost[kind]
                 busy_until[d] = rounds + c

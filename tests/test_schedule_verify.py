@@ -271,6 +271,9 @@ def test_zb_h1_compile_decision_is_negative():
         '            cost = {"F": 1, "B": 1, "W": 1}',
         'cost = {"F": 1, "B": 3, "W": 0}\n        if split_bw:\n'
         '            cost = {"F": 1, "B": 2, "W": 2}')
+    assert '"B": 2, "W": 2' in code, (
+        "source patch did not apply — simulate_zb's cost block moved; "
+        "update this test's replace targets")
     ns = {}
     exec(compile(code, "<zb-jax>", "exec"), vars(V), ns)
     for n_mu, pp in ((16, 4), (32, 8), (8, 2)):

@@ -645,18 +645,21 @@ def train(args) -> float:
         finally:
             engine.params = live
 
-    def val_loss() -> float:
+    def val_loss(step: int = 0) -> float:
         """Held-out loss: --text tail, or a seed stream disjoint from
         training (steps are seeded [seed, step]; val uses [seed+1, ...]).
-        Each call draws a FRESH batch of held-out windows (seeded by the
-        eval counter) so the metric tracks the distribution, not a fixed
+        Each call draws a FRESH batch of held-out windows — seeded by
+        the TRAINING STEP (round 4: the old eval-counter seed made a
+        resumed run draw different val windows than the uninterrupted
+        run at the same step, so val curves were not comparable across
+        restarts) — so the metric tracks the distribution, not a fixed
         handful of examples. With --ema-decay, evaluates the averaged
         weights (what you would ship), not the raw iterate."""
         nonlocal n_evals
         n_evals += 1
         val_args = args if val_data is not None else argparse.Namespace(
             **{**vars(args), "seed": args.seed + 1})
-        tok, tgt = make_batch(val_args, vocab, 10**9 + n_evals, val_data)
+        tok, tgt = make_batch(val_args, vocab, 10**9 + step, val_data)
         with ema_weights():
             return float(engine.eval_loss(local_rows(tok),
                                           local_rows(tgt)))
@@ -760,7 +763,7 @@ def train(args) -> float:
                     # booked as val time (val points need not be log points)
                     jax.block_until_ready(loss_dev)
                     tv = time.time()
-                    vl = val_loss()
+                    vl = val_loss(step)
                     val_time += time.time() - tv
                     rprint(f"step {step:5d}  val_loss {vl:.4f}  "
                            f"ppl {np.exp(min(vl, 20)):,.2f}")
