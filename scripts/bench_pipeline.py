@@ -26,34 +26,25 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import numpy as np  # noqa: E402
 
-def _wanted_devices() -> int:
-    """Pre-scan argv for --pp/--sp so the forced CPU device pool is big
-    enough for the requested mesh (flags must land before jax imports)."""
-    import re as _re
+def _setup_platform(n_devices: int) -> None:
+    """Force a CPU device pool big enough for the requested mesh —
+    BEFORE the first jax import (the bench_decode.py pattern:
+    argparse first, then flags, then jax)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{n_devices}").strip()
+    import jax
 
-    vals = {"--pp": 2, "--sp": 1}
-    argv = sys.argv
-    for i, a in enumerate(argv):
-        for k in vals:
-            if a == k and i + 1 < len(argv):
-                vals[k] = max(1, int(argv[i + 1]))
-            elif _re.fullmatch(_re.escape(k) + r"=(\d+)", a):
-                vals[k] = max(1, int(a.split("=", 1)[1]))
-    return max(8, vals["--pp"] * vals["--sp"])
-
-
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count="
-        f"{_wanted_devices()}").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= n_devices, (
+        f"this mesh needs {n_devices} devices but the platform has "
+        f"{len(jax.devices())} (XLA_FLAGS pinned a smaller pool?)")
 
 
 def bench_engine(schedule, args, virtual_pp=1, sp=1):
+    import jax
     from jax.sharding import Mesh
 
     from shallowspeed_tpu.models.transformer import TransformerConfig
@@ -113,6 +104,8 @@ def main():
                     help="also benchmark interleaved virtual stages at "
                          "this chunk count (0/1 = skip)")
     args = ap.parse_args()
+    _setup_platform(max(8, args.pp * max(1, args.sp)))
+    import jax  # noqa: F401  (platform configured above)
 
     gpipe = bench_engine("gpipe", args)
     f1b1 = bench_engine("1f1b", args)

@@ -377,6 +377,12 @@ def train(args) -> float:
         raise SystemExit(f"--pp with --sp needs a sequence-parallel "
                          f"attention substrate (--attn ring, ring-flash "
                          f"or ulysses-flash), got {args.attn}")
+    if args.pp > 1 and args.sp > 1 and args.pp_schedule == "1f1b":
+        print("note: on an sp mesh the 1F1B ticks cannot skip (the F/B "
+              "halves run unmasked so every device issues the same "
+              "collective schedule) — measured ~0.5x GPipe's throughput "
+              "(BASELINE.md '1F1B x sp'); --pp-schedule gpipe is the "
+              "fast choice here", file=sys.stderr)
     if args.pp > 1 and args.sp == 1 and args.attn not in ("ring", "flash"):
         raise SystemExit(f"--attn {args.attn} is not available with --pp "
                          "(XLA attention by default, or the fused Pallas "
@@ -575,7 +581,6 @@ def train(args) -> float:
     metrics = MetricsLogger(args.log_file, dp=args.dp, sp=args.sp,
                             seq_len=args.seq_len, d_model=args.d_model,
                             n_layers=args.n_layers)
-    n_evals = 0
     saver = checkpoint.AsyncSaver() if args.async_save else None
 
     def save_ckpt(ckpt_dir, step):
@@ -645,7 +650,7 @@ def train(args) -> float:
         finally:
             engine.params = live
 
-    def val_loss(step: int = 0) -> float:
+    def val_loss(step: int) -> float:
         """Held-out loss: --text tail, or a seed stream disjoint from
         training (steps are seeded [seed, step]; val uses [seed+1, ...]).
         Each call draws a FRESH batch of held-out windows — seeded by
@@ -655,8 +660,6 @@ def train(args) -> float:
         restarts) — so the metric tracks the distribution, not a fixed
         handful of examples. With --ema-decay, evaluates the averaged
         weights (what you would ship), not the raw iterate."""
-        nonlocal n_evals
-        n_evals += 1
         val_args = args if val_data is not None else argparse.Namespace(
             **{**vars(args), "seed": args.seed + 1})
         tok, tgt = make_batch(val_args, vocab, 10**9 + step, val_data)
