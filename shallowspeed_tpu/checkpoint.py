@@ -109,7 +109,7 @@ def load_pytree(path, with_meta: bool = False):
 
 def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
                 extra: dict, opt_canon=None, canon_meta=None,
-                sync: bool = True) -> Path:
+                sync: bool = True, keep: int | None = None) -> Path:
     """The one encoding of the on-disk layout + atomic rename, shared by
     the synchronous and async save paths (they must never drift).
 
@@ -145,6 +145,8 @@ def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
     if final.exists():
         shutil.rmtree(final)
     tmp.rename(final)
+    if keep:
+        prune(ckpt_dir, keep)
     if sync:
         # releases the other processes only once the rename landed
         barrier(f"ckpt_{epoch}")
@@ -215,7 +217,26 @@ def _canon_opt_import(engine, canon):
         return None
 
 
-def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
+def prune(ckpt_dir, keep: int) -> None:
+    """Delete all COMPLETE `ckpt_N` directories except the `keep`
+    highest-epoch ones (rotation — a long elastic run otherwise
+    accumulates multi-GB checkpoints without bound). `.tmp` leftovers
+    and foreign names are untouched; the newest checkpoints survive, so
+    `latest()` is unaffected. Process-0-only by construction (called
+    from the write path)."""
+    assert keep >= 1, f"prune keeps at least one checkpoint, got {keep}"
+    d = Path(ckpt_dir)
+    found = []
+    for p in d.iterdir() if d.exists() else ():
+        m = re.fullmatch(r"ckpt_(\d+)", p.name)
+        if m and all((p / f).exists() for f in _FILES):
+            found.append((int(m.group(1)), p))
+    for _, p in sorted(found)[:-keep or None]:
+        shutil.rmtree(p, ignore_errors=True)
+
+
+def save(ckpt_dir, engine, epoch: int, extra: dict | None = None,
+         keep: int | None = None) -> Path:
     """Atomically write `ckpt_dir/ckpt_{epoch}/`: canonical params + engine
     opt state. Writes into `ckpt_{epoch}.tmp/` and renames into place so a
     crash mid-save cannot produce a directory `latest()` would select.
@@ -232,7 +253,8 @@ def save(ckpt_dir, engine, epoch: int, extra: dict | None = None) -> Path:
     opt_canon, canon_meta = _canon_opt_export(engine, host_opt)
     return _write_ckpt(
         ckpt_dir, epoch, engine.get_canonical_params(), host_opt,
-        _opt_meta(engine, epoch), extra or {}, opt_canon, canon_meta)
+        _opt_meta(engine, epoch), extra or {}, opt_canon, canon_meta,
+        keep=keep)
 
 
 class AsyncSaver:
@@ -278,7 +300,7 @@ class AsyncSaver:
             raise RuntimeError("async checkpoint save failed") from err
 
     def save(self, ckpt_dir, engine, epoch: int,
-             extra: dict | None = None) -> None:
+             extra: dict | None = None, keep: int | None = None) -> None:
         """Snapshot now, write later. The snapshot is a host copy, so
         the engine may keep training (and donating buffers) immediately.
         The snapshot fetch runs on the CALLER's thread — in a
@@ -300,7 +322,8 @@ class AsyncSaver:
             # would interleave with the training stream's); wait()
             # barriers on the caller's thread instead
             _write_ckpt(ckpt_dir, epoch, params, opt_state, meta,
-                        extra_host, opt_canon, canon_meta, sync=False)
+                        extra_host, opt_canon, canon_meta, sync=False,
+                        keep=keep)
 
         self._q.put(write)
 

@@ -232,3 +232,21 @@ def test_no_pickle_in_checkpoint_files(tmp_path, data_dir):
     for f in ("params.npz", "opt.npz"):
         with np.load(tmp_path / "ckpt_0" / f, allow_pickle=False) as z:
             assert "spec" in z.files
+
+
+def test_prune_keeps_newest(tmp_path):
+    """Checkpoint rotation (round 4): save with keep=2 retains only the
+    two newest complete checkpoints; .tmp leftovers and foreign names
+    are untouched; latest() still points at the newest."""
+    from shallowspeed_tpu import checkpoint
+
+    eng = fused_engine()
+    (tmp_path / "ckpt_9.tmp").mkdir()          # crash leftover
+    (tmp_path / "ckpt_foreign").mkdir()        # not ours
+    for epoch in (1, 2, 3, 4):
+        checkpoint.save(str(tmp_path), eng, epoch, keep=2)
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert "ckpt_3" in names and "ckpt_4" in names
+    assert "ckpt_1" not in names and "ckpt_2" not in names
+    assert "ckpt_9.tmp" in names and "ckpt_foreign" in names
+    assert checkpoint.latest(str(tmp_path)).name == "ckpt_4"

@@ -218,6 +218,11 @@ def parse_args(argv=None):
                    help="write checkpoints on a background thread: the "
                         "device->host snapshot is synchronous (pins the "
                         "state), compression/IO never blocks training")
+    p.add_argument("--keep-checkpoints", type=int, default=0,
+                   help="checkpoint rotation: keep only the N newest "
+                        "ckpt_* dirs (0 = keep all); a long elastic "
+                        "run otherwise accumulates multi-GB "
+                        "checkpoints without bound")
     p.add_argument("--save-every", type=int, default=100,
                    help="checkpoint every N steps when --save-dir is set")
     p.add_argument("--save-dir", type=str, default="")
@@ -389,6 +394,9 @@ def train(args) -> float:
                          "kernel via --attn flash)")
     if args.ep > 1 and args.tp > 1:
         raise SystemExit("--ep composes with --dp/--sp (not --tp)")
+    if args.keep_checkpoints < 0:
+        raise SystemExit("--keep-checkpoints takes 0 (keep all) or a "
+                         "positive count")
     if args.fsdp and (args.ep > 1 or args.experts or args.zero1
                       or args.zero2):
         raise SystemExit("--fsdp composes with --dp/--sp/--tp/--pp (and already "
@@ -585,10 +593,12 @@ def train(args) -> float:
 
     def save_ckpt(ckpt_dir, step):
         extra = ({"ema": ema_canonical()} if ema is not None else None)
+        keep = args.keep_checkpoints or None
         if saver is not None:
-            saver.save(ckpt_dir, engine, step, extra=extra)
+            saver.save(ckpt_dir, engine, step, extra=extra, keep=keep)
         else:
-            checkpoint.save(ckpt_dir, engine, step, extra=extra)
+            checkpoint.save(ckpt_dir, engine, step, extra=extra,
+                            keep=keep)
 
     # ---- EMA of the weights: driver-owned, engine-agnostic (a pure
     # elementwise update on the engine's live params tree, whatever its
