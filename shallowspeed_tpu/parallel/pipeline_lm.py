@@ -230,10 +230,17 @@ class PipelineLMEngine:
             assert self.dp > 1, (
                 "--zero1/--zero2/--fsdp shard over dp; need dp > 1")
         if zero2 or fsdp:
-            assert not self.has_sp and not self.has_tp and \
-                not self.has_ep and virtual_pp == 1, (
-                    "zero2/fsdp x pp support the plain ('dp','pp') mesh "
-                    "(no sp/tp/ep axis, no virtual stages)")
+            # tp composes (round 4): the dp reduce-scatter/all-gather
+            # acts on each leaf's ZeRO dim while tp reductions stay
+            # with variance-typed autodiff, and zero2_grad_specs picks
+            # a free (non-'pp'/'tp') dim per leaf. sp/ep stay out: their
+            # uniform-execution 1F1B path hands raw per-device partials
+            # to a single post-scan reduction whose shape the
+            # reduce-scatter substitution does not yet cover.
+            assert not self.has_sp and not self.has_ep and \
+                virtual_pp == 1, (
+                    "zero2/fsdp x pp support ('dp','pp'[,'tp']) meshes "
+                    "(no sp/ep axis, no virtual stages)")
         self.n_mu = n_mubatches
         self.l_local = cfg.n_layers // self.pp
         self.optimizer = optimizer

@@ -363,9 +363,9 @@ def train(args) -> float:
         raise SystemExit("--pp with --zero1/--zero2/--fsdp shards over "
                          "dp; need --dp >= 2")
     if args.pp > 1 and (args.zero2 or args.fsdp) \
-            and (args.sp > 1 or args.tp > 1 or args.ep > 1):
-        raise SystemExit("--pp with --zero2/--fsdp takes the plain "
-                         "('dp','pp') mesh (no --sp/--tp/--ep)")
+            and (args.sp > 1 or args.ep > 1):
+        raise SystemExit("--pp with --zero2/--fsdp takes a "
+                         "('dp','pp'[,'tp']) mesh (no --sp/--ep)")
     if args.pp > 1 and sum(a > 1 for a in (args.tp, args.sp,
                                            args.ep)) > 1:
         raise SystemExit("--pp takes ONE extra model axis: --tp, --sp, "
