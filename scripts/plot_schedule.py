@@ -47,7 +47,10 @@ def cells(report, pp):
 def ascii_graph(name, report, pp, n_mu, training) -> str:
     grid = cells(report, pp)
     span = report.makespan
-    work = (2 if training else 1) * n_mu
+    # bubble from the grid itself (generalizes to the interleaved/ZB
+    # panels, whose per-stage op counts differ from 2*n_mu)
+    work = max((sum(1 for (st, _) in grid if st == s)
+                for s in range(pp)), default=0)
     bubble = 1.0 - work / span if span else 0.0
     out = [f"{name}  pp={pp}  n_mu={n_mu}  makespan={span} rounds  "
            f"bubble={bubble:.0%}  peak stash={report.peak_stash}"]
@@ -79,9 +82,14 @@ def svg_graph(reports, pp, n_mu, path):
                 x = pad + 70 + r * cw
                 yy = y + s * ch
                 if lab:
-                    mu = int(lab[1:])
+                    import re as _re
+
+                    m_ = _re.match(r"[FB](\d+)", lab)
+                    mu = int(m_.group(1)) if m_ else 0
                     shade = 35 + int(45 * (mu / max(1, n_mu - 1)))
                     hue = 210 if lab[0] == "F" else 25
+                    if lab.endswith("w"):
+                        hue = 130  # ZB weight-grad fill: green family
                     fill = f"hsl({hue},70%,{shade}%)"
                     parts.append(
                         f'<rect x="{x}" y="{yy}" width="{cw - 2}" '
@@ -100,10 +108,66 @@ def svg_graph(reports, pp, n_mu, path):
     Path(path).write_text("\n".join(parts))
 
 
+def interleaved_report(n_mu, pp, vpp):
+    """Round-4 schedules rendered from the SAME artifacts the engine
+    executes / the simulator proves: the interleaved-1F1B tables
+    (verify.interleaved_tables — exactly what the compiled vpp x 1f1b
+    engine follows) and the ZB-H1 list schedule (verify.simulate_zb).
+    Both are shaped into SimReport-compatible grids: the interleaved
+    grid labels chunk 0 'F<mu>'/'B<mu>' and chunk v >= 1 lowercase, so
+    the pebble graph shows the chunk interleaving directly."""
+    from shallowspeed_tpu.parallel.verify import interleaved_tables
+
+    tb = interleaved_tables(n_mu, pp, vpp)
+
+    class _Rep:
+        makespan = tb.n_rounds
+        peak_stash = [tb.n_stash_slots] * pp
+        fwd_rounds = {}
+        bwd_rounds = {}
+
+    rep = _Rep()
+    for r in range(tb.n_rounds):
+        for d in range(pp):
+            op, v, mu = tb.op[r, d], tb.chunk[r, d], tb.mu[r, d]
+            if op == 0:
+                continue
+            # encode the chunk into the "mu" slot: renderer prints F/B
+            # + number; lowercase marks chunks >= 1
+            target = rep.fwd_rounds if op == 1 else rep.bwd_rounds
+            target[(d, f"{mu}" if v == 0 else f"{mu}'")] = r
+    return rep
+
+
+def zb_report(n_mu, pp):
+    from shallowspeed_tpu.parallel.verify import simulate_zb
+
+    zb = simulate_zb(n_mu, pp)
+
+    class _Rep:
+        makespan = zb.makespan
+        peak_stash = zb.peak_stash
+        fwd_rounds = {}
+        bwd_rounds = {}
+
+    rep = _Rep()
+    for (kind, l, mu), r in zb.op_rounds.items():
+        if kind == "F":
+            rep.fwd_rounds[(l, f"{mu}")] = r
+        elif kind == "B":
+            rep.bwd_rounds[(l, f"{mu}")] = r
+        else:  # W: weight-grad fill — rendered as w<mu>
+            rep.bwd_rounds[(l, f"{mu}w")] = r
+    return rep
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pp", type=int, default=4)
     ap.add_argument("--n-mu", type=int, default=8)
+    ap.add_argument("--virtual-pp", type=int, default=2,
+                    help="chunk count for the interleaved-1F1B panel "
+                         "(0/1 = skip; needs n_mu, pp from above)")
     ap.add_argument("--svg", type=str, default="",
                     help="also write a stacked SVG to this path")
     args = ap.parse_args()
@@ -114,6 +178,18 @@ def main():
         reports.append((name, rep, training))
         print(ascii_graph(name, rep, args.pp, args.n_mu, training))
         print()
+    if args.virtual_pp > 1:
+        rep = interleaved_report(args.n_mu, args.pp, args.virtual_pp)
+        name = (f"interleaved 1f1b (vpp={args.virtual_pp}; chunk>=1 "
+                f"marked ')")
+        reports.append((name, rep, True))
+        print(ascii_graph(name, rep, args.pp, args.n_mu, True))
+        print()
+    repz = zb_report(args.n_mu, args.pp)
+    reports.append(("ZB-H1 zero-bubble (W ops marked w)", repz, True))
+    print(ascii_graph("ZB-H1 zero-bubble (W ops marked w)", repz,
+                      args.pp, args.n_mu, True))
+    print()
     if args.svg:
         svg_graph(reports, args.pp, args.n_mu, args.svg)
         print(f"wrote {args.svg}")

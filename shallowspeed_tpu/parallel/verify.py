@@ -484,6 +484,9 @@ class ZBReport:
     bubble: int            # ZB idle rounds inside the busy window, worst device
     f1b1_bubble: int
     peak_stash: list       # per-device peak (act stashes + W-pending stashes)
+    op_rounds: dict = field(default_factory=dict)
+    # ("F"|"B"|"W", stage, mu) -> START round of the ZB-H1 schedule
+    # (the renderer's feed — plot_schedule draws what was verified)
 
 
 def simulate_zb(num_micro_batches: int, pp: int) -> ZBReport:
@@ -515,6 +518,7 @@ def simulate_zb(num_micro_batches: int, pp: int) -> ZBReport:
         if split_bw:
             cost = {"F": 1, "B": 1, "W": 1}
         done = {}
+        starts = {}
         pending = set()
         for l in range(pp):
             for m in range(n_mu):
@@ -562,6 +566,7 @@ def simulate_zb(num_micro_batches: int, pp: int) -> ZBReport:
                 c = cost[kind]
                 busy_until[d] = rounds + c
                 done[op] = rounds + c - 1
+                starts[op] = rounds
                 pending.discard(op)
                 if first_busy[d] is None:
                     first_busy[d] = rounds
@@ -588,10 +593,10 @@ def simulate_zb(num_micro_batches: int, pp: int) -> ZBReport:
         bubble = max(
             (makespan - (first_busy[d] or 0)) - work_rounds[d]
             for d in range(pp))
-        return makespan, bubble, peak
+        return makespan, bubble, peak, starts
 
-    zb_makespan, zb_bubble, zb_peak = run(True)
-    f_makespan, f_bubble, _ = run(False)
+    zb_makespan, zb_bubble, zb_peak, zb_starts = run(True)
+    f_makespan, f_bubble, _, _ = run(False)
     return ZBReport(makespan=zb_makespan, f1b1_makespan=f_makespan,
                     bubble=zb_bubble, f1b1_bubble=f_bubble,
-                    peak_stash=zb_peak)
+                    peak_stash=zb_peak, op_rounds=zb_starts)

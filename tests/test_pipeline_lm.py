@@ -608,10 +608,12 @@ def test_pp_zero2_guards():
     with pytest.raises(AssertionError, match="pick ONE"):
         PipelineLMEngine(CFG, Adam(1e-2), pp_mesh(2, 2), zero1=True,
                          zero2=True)
+    # round 4: tp now COMPOSES with zero2/fsdp x pp; sp stays excluded
     devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
-    with pytest.raises(AssertionError, match="plain"):
+    with pytest.raises(AssertionError, match="no sp/ep"):
         PipelineLMEngine(CFG, Adam(1e-2),
-                         Mesh(devs, ("dp", "pp", "tp")), zero2=True)
+                         Mesh(devs, ("dp", "pp", "sp")), zero2=True,
+                         attn="ring")
 
 
 def test_pp_fsdp_matches_dense_pipeline():
