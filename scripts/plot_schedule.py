@@ -16,6 +16,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import re
 import sys
 from pathlib import Path
 
@@ -82,9 +83,7 @@ def svg_graph(reports, pp, n_mu, path):
                 x = pad + 70 + r * cw
                 yy = y + s * ch
                 if lab:
-                    import re as _re
-
-                    m_ = _re.match(r"[FB](\d+)", lab)
+                    m_ = re.match(r"[FB](\d+)", lab)
                     mu = int(m_.group(1)) if m_ else 0
                     shade = 35 + int(45 * (mu / max(1, n_mu - 1)))
                     hue = 210 if lab[0] == "F" else 25
@@ -132,10 +131,11 @@ def interleaved_report(n_mu, pp, vpp):
             op, v, mu = tb.op[r, d], tb.chunk[r, d], tb.mu[r, d]
             if op == 0:
                 continue
-            # encode the chunk into the "mu" slot: renderer prints F/B
-            # + number; lowercase marks chunks >= 1
+            # encode the chunk into the "mu" slot: v apostrophes mark
+            # chunk v (distinct keys per chunk — vpp >= 3 must not
+            # collapse ops onto one cell)
             target = rep.fwd_rounds if op == 1 else rep.bwd_rounds
-            target[(d, f"{mu}" if v == 0 else f"{mu}'")] = r
+            target[(d, f"{mu}" + "'" * int(v))] = r
     return rep
 
 
@@ -156,7 +156,7 @@ def zb_report(n_mu, pp):
             rep.fwd_rounds[(l, f"{mu}")] = r
         elif kind == "B":
             rep.bwd_rounds[(l, f"{mu}")] = r
-        else:  # W: weight-grad fill — rendered as w<mu>
+        else:  # W: weight-grad fill — cell renders as B<mu>w
             rep.bwd_rounds[(l, f"{mu}w")] = r
     return rep
 
