@@ -67,7 +67,10 @@ def initialize(coordinator_address: str | None = None,
                         else _env_int("JAX_PROCESS_ID")))
         return True
     except RuntimeError as e:  # already initialized — idempotent
-        if "already initialized" in str(e).lower():
+        # jax has used both wordings across versions: "already
+        # initialized" and "initialize should only be called once"
+        msg = str(e).lower()
+        if "already initialized" in msg or "called once" in msg:
             return False
         raise
 
