@@ -117,7 +117,12 @@ def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
     (every process replicates non-addressable leaves together —
     `distributed.fetch_global`), then only process 0 touches the
     filesystem, then a barrier releases the others — so a save at one
-    process topology restores at any other."""
+    process topology restores at any other.
+
+    SHARED-FILESYSTEM CONTRACT: only process 0 writes, but `restore()`
+    reads on EVERY process — multi-host gangs need `ckpt_dir` on a
+    filesystem all hosts mount (NFS/GCS-fuse). A host that can't see the
+    directory fails fast in `restore()` with this requirement named."""
     from shallowspeed_tpu.distributed import (barrier, fetch_global,
                                               process_zero)
 
@@ -299,6 +304,20 @@ class AsyncSaver:
             err, self._err = self._err, None
             raise RuntimeError("async checkpoint save failed") from err
 
+    def _raise_collectively(self):
+        """Exchange a success bit (a collective, so also a barrier) and
+        raise on EVERY process when any peer's background write failed.
+        Only process 0 writes, so without the exchange its peers would
+        sail past their (empty) pending-error check straight into the
+        next collective against a process that is about to die — wedging
+        the gang until hang-timeout."""
+        from shallowspeed_tpu.distributed import all_ok
+
+        if not all_ok(self._err is None):
+            self._raise_pending()  # the failing process re-raises its own
+            raise RuntimeError(
+                "async checkpoint save failed on a peer process")
+
     def save(self, ckpt_dir, engine, epoch: int,
              extra: dict | None = None, keep: int | None = None) -> None:
         """Snapshot now, write later. The snapshot is a host copy, so
@@ -309,7 +328,7 @@ class AsyncSaver:
         collective order identical to its training stream."""
         from shallowspeed_tpu.distributed import fetch_global
 
-        self._raise_pending()
+        self._raise_collectively()
         params = fetch_global(engine.get_canonical_params())
         opt_state = fetch_global(engine.opt_state)
         opt_canon, canon_meta = _canon_opt_export(engine, opt_state)
@@ -329,15 +348,21 @@ class AsyncSaver:
 
     def wait(self) -> None:
         """Block until every queued save is on disk; re-raise failures.
-        Multi-controller: also barriers, so after wait() every process
-        may trust `latest()`."""
-        from shallowspeed_tpu.distributed import barrier
-
+        Multi-controller: exchanges a success bit collectively (which is
+        also the drain barrier), so if process 0's background write
+        failed EVERY process raises here together — peers never proceed
+        trusting `latest()` while process 0 is about to die (which would
+        wedge the gang until hang-timeout)."""
         self._q.join()
-        barrier("async-ckpt-drain")
-        self._raise_pending()
+        self._raise_collectively()
 
     def close(self) -> None:
+        """Drain, stop the worker, re-raise failures LOCALLY. No
+        collective here: close() runs on exception/teardown paths where
+        peers may be anywhere (a one-process failure must exit promptly,
+        not block in a collective until hang-timeout). Multi-controller
+        clean-shutdown callers should `wait()` first — that is the
+        collective everyone-raises-together point."""
         self._q.join()
         self._q.put(None)
         self._q.join()
@@ -414,6 +439,18 @@ def restore(engine, ckpt_path) -> int:
     engine-shaped, e.g. stacked per-stage for the SPMD engine).
     """
     d = Path(ckpt_path)
+    if not (d / "params.npz").exists():
+        msg = f"checkpoint {d} has no params.npz"
+        if jax.process_count() > 1:
+            # only diagnose the shared-FS contract when it can apply —
+            # a single-process wrong --resume path gets the plain error
+            msg += (f" (process {jax.process_index()} of "
+                    f"{jax.process_count()}). Multi-controller restore "
+                    f"reads on every process while save writes only on "
+                    f"process 0 — the checkpoint dir must live on a "
+                    f"filesystem ALL hosts mount (see _write_ckpt's "
+                    f"shared-filesystem contract)")
+        raise FileNotFoundError(msg)
     params = load_pytree(d / "params.npz")
     mismatch = _structure_mismatch(params, engine.get_canonical_params())
     if mismatch is not None:

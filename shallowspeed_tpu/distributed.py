@@ -95,6 +95,23 @@ def barrier(tag: str = "barrier") -> None:
         multihost_utils.sync_global_devices(tag)
 
 
+def all_ok(flag: bool) -> bool:
+    """Collective AND of a per-process success bit; doubles as a barrier.
+
+    Use wherever one process can fail while its peers would otherwise
+    proceed trusting shared state (e.g. an async checkpoint write that
+    only process 0 performs): every process learns the fleet-wide
+    verdict at the same point, so failures raise TOGETHER instead of
+    wedging the gang in the next collective. Single-process: returns
+    `flag` unchanged."""
+    if jax.process_count() == 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+
+    bits = multihost_utils.process_allgather(np.asarray(bool(flag)))
+    return bool(np.all(bits))
+
+
 def hybrid_mesh(axis_names: tuple[str, ...], axis_sizes: tuple[int, ...],
                 *, dcn_axes: int = 1, devices=None) -> Mesh:
     """A mesh whose leftmost `dcn_axes` axes span slices over DCN and whose

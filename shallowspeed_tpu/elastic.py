@@ -100,6 +100,7 @@ class Supervisor:
         self.hang_timeout = hang_timeout
         self.poll_interval = poll_interval
         self.log = log
+        self._owned_hb = False  # did WE mkstemp it (then we unlink it)
         if hang_timeout is not None and heartbeat_file is None:
             if "--heartbeat-file" in self.argv:
                 heartbeat_file = self.argv[
@@ -108,6 +109,7 @@ class Supervisor:
                 fd, heartbeat_file = tempfile.mkstemp(prefix="hb_")
                 os.close(fd)
                 self.argv += ["--heartbeat-file", heartbeat_file]
+                self._owned_hb = True
         self.heartbeat_file = heartbeat_file
 
     # ------------------------------------------------------------ child
@@ -150,9 +152,25 @@ class Supervisor:
 
     # ------------------------------------------------------------- loop
 
+    def _cleanup_heartbeats(self) -> None:
+        """Unlink heartbeat tmpfiles THIS supervisor created (never a
+        caller-provided file). Subclasses with different heartbeat
+        ownership override this one hook."""
+        if self._owned_hb and self.heartbeat_file:
+            try:
+                os.unlink(self.heartbeat_file)
+            except OSError:
+                pass
+
     def run(self) -> int:
         """Supervise until the child exits 0 or the restart budget is
         exhausted; returns the final exit code."""
+        try:
+            return self._supervise()
+        finally:
+            self._cleanup_heartbeats()
+
+    def _supervise(self) -> int:
         attempt = 0
         while True:
             attempt += 1
@@ -221,6 +239,15 @@ class GangSupervisor(Supervisor):
                 os.close(fd)
                 self.heartbeat_files.append(path)
 
+    def _cleanup_heartbeats(self) -> None:
+        # gang mode owns all N injected tmpfiles; a host running
+        # repeated gangs must not accumulate them
+        for path in self.heartbeat_files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
     def _free_port(self) -> int:
         import socket
 
@@ -239,19 +266,26 @@ class GangSupervisor(Supervisor):
         t0 = time.monotonic()
         coord = self.coordinator or f"localhost:{self._free_port()}"
         children = []
-        for i in range(self.n):
-            argv = list(self.argv)
-            if self.heartbeat_files:
-                try:
-                    os.utime(self.heartbeat_files[i], None)
-                except OSError:
-                    open(self.heartbeat_files[i], "w").close()
-                argv += ["--heartbeat-file", self.heartbeat_files[i]]
-            env = {**os.environ,
-                   "JAX_COORDINATOR_ADDRESS": coord,
-                   "JAX_NUM_PROCESSES": str(self.n),
-                   "JAX_PROCESS_ID": str(i)}
-            children.append(subprocess.Popen(argv, env=env))
+        try:
+            for i in range(self.n):
+                argv = list(self.argv)
+                if self.heartbeat_files:
+                    try:
+                        os.utime(self.heartbeat_files[i], None)
+                    except OSError:
+                        open(self.heartbeat_files[i], "w").close()
+                    argv += ["--heartbeat-file", self.heartbeat_files[i]]
+                env = {**os.environ,
+                       "JAX_COORDINATOR_ADDRESS": coord,
+                       "JAX_NUM_PROCESSES": str(self.n),
+                       "JAX_PROCESS_ID": str(i)}
+                children.append(subprocess.Popen(argv, env=env))
+        except Exception:
+            # a failed spawn (ENOMEM, bad argv) must not leave the
+            # already-launched members running — they would re-touch
+            # their heartbeat files after run()'s cleanup unlinked them
+            self._kill_gang(children)
+            raise
         hb_seen = [time.time()] * self.n
         while True:
             codes = [c.poll() for c in children]

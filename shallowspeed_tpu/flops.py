@@ -121,7 +121,7 @@ def transformer_flops_per_token(cfg, seq_len: int,
 
 
 def mfu(tokens_per_sec: float, cfg, seq_len: int,
-        dtype: str = "bf16", device=None, n_devices: int = 1,
+        dtype: str = "bf16", device=None, n_devices: int | None = None,
         include_backward: bool = True, n_chips: int | None = None) -> dict:
     """Achieved TFLOP/s and fraction-of-peak for a measured throughput.
 
@@ -133,7 +133,19 @@ def mfu(tokens_per_sec: float, cfg, seq_len: int,
     "peak_tflops": fleet peak or None, "mfu": fraction or None}. MFU is
     None off-TPU (unknown peak)."""
     if n_chips is not None:  # deprecated pre-round-4 keyword
+        import warnings
+
+        warnings.warn("mfu(n_chips=...) is deprecated; pass n_devices",
+                      DeprecationWarning, stacklevel=2)
+        # None-sentinel default so an EXPLICIT n_devices=1 still
+        # conflicts (1 being the old default must not mask it)
+        if n_devices is not None and n_devices != n_chips:
+            raise ValueError(
+                f"both n_devices={n_devices} and n_chips={n_chips} "
+                f"given and they disagree; pass only n_devices")
         n_devices = n_chips
+    if n_devices is None:
+        n_devices = 1
     fpt = transformer_flops_per_token(cfg, seq_len, include_backward)
     achieved = tokens_per_sec * fpt
     peak = device_peak_flops(device, dtype)

@@ -50,6 +50,9 @@ def main() -> None:
     if mode in ("pp", "ppsp"):
         _pipeline_mode(pid, mode)
         return
+    if mode == "allok":
+        _allok_mode(pid)
+        return
 
     # dp=4 spans BOTH processes: the gradient pmean/psum crosses the
     # process boundary; place_global stitches each process's local row
@@ -73,6 +76,33 @@ def main() -> None:
 
     w = np.asarray(jax.device_get(eng.params["tok_emb"]))
     print(f"HASH {pid} {hashlib.sha1(w.tobytes()).hexdigest()}", flush=True)
+    barrier("done")
+    print(f"DONE {pid}", flush=True)
+
+
+def _allok_mode(pid: int) -> None:
+    """The collective success-bit exchange (`distributed.all_ok`) and the
+    AsyncSaver failure contract ACROSS a real process boundary: when
+    process 0's background checkpoint write failed, `wait()` must raise
+    on EVERY process (the exchange is what stops peers trusting
+    `latest()` and wedging the gang in the next collective — ADVICE r4)."""
+    from shallowspeed_tpu.checkpoint import AsyncSaver
+    from shallowspeed_tpu.distributed import all_ok, barrier
+
+    assert all_ok(True) is True
+    assert all_ok(pid != 0) is False  # any one process failing
+    assert all_ok(pid == 0) is False  # ... regardless of which
+    assert all_ok(False) is False
+
+    saver = AsyncSaver()
+    if pid == 0:  # simulate a failed background write on the writer
+        saver._err = RuntimeError("simulated disk-full write failure")
+    try:
+        saver.wait()
+        raised = "no"
+    except RuntimeError:
+        raised = "yes"
+    print(f"WAITRAISED {pid} {raised}", flush=True)
     barrier("done")
     print(f"DONE {pid}", flush=True)
 

@@ -289,3 +289,11 @@ def test_two_process_ring_attention_crosses_boundary():
                                      three_axis=True)
     for step, r in enumerate(ref):
         assert got[f"gpipe:{step}"] == pytest.approx(r, rel=1e-4), step
+
+def test_two_process_async_save_failure_raises_on_all():
+    """all_ok's multi-process exchange + AsyncSaver._raise_collectively
+    across a REAL process boundary: a (simulated) failed background
+    write on process 0 must make wait() raise on BOTH processes."""
+    outs = _spawn_workers("allok")
+    w0, w1 = (_parse(out, "WAITRAISED") for out in outs)
+    assert w0 == [["yes"]] and w1 == [["yes"]], (w0, w1)

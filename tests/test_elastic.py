@@ -327,3 +327,48 @@ def test_gang_supervises_real_multicontroller_training(tmp_path):
     assert "killing the gang" in text, text[-2000:]
     assert "resumed from" in text, text[-2000:]
     assert "[elastic] attempt 2" in text, text[-2000:]
+
+# ------------------------------------------------- heartbeat hygiene
+
+
+def test_supervisor_cleans_up_owned_heartbeat_file(tmp_path):
+    """ADVICE r4: a supervisor that mkstemp'd its own heartbeat file
+    must unlink it when run() returns."""
+    cmd = _script(tmp_path, "raise SystemExit(0)")
+    sup = Supervisor(cmd, RestartPolicy(max_restarts=1, backoff=0.01),
+                     hang_timeout=30.0, poll_interval=0.1,
+                     log=lambda *_: None)
+    hb = Path(sup.heartbeat_file)
+    assert hb.exists()
+    assert sup.run() == 0
+    assert not hb.exists()
+
+
+def test_supervisor_leaves_caller_owned_heartbeat_file(tmp_path):
+    """A heartbeat file the CALLER passed is not ours to delete."""
+    hb = tmp_path / "hb"
+    hb.touch()
+    cmd = _script(tmp_path, "raise SystemExit(0)") + [
+        "--heartbeat-file", str(hb)]
+    sup = Supervisor(cmd, RestartPolicy(max_restarts=1, backoff=0.01),
+                     hang_timeout=30.0, poll_interval=0.1,
+                     log=lambda *_: None)
+    assert sup.run() == 0
+    assert hb.exists()
+
+
+def test_gang_supervisor_cleans_up_heartbeat_files(tmp_path):
+    """ADVICE r4: gang mode injects N tmpfiles; all N must be unlinked
+    when run() returns (long-lived hosts run many gangs)."""
+    from shallowspeed_tpu.elastic import GangSupervisor
+
+    cmd = _script(tmp_path, "raise SystemExit(0)")
+    sup = GangSupervisor(cmd, n_procs=2,
+                         policy=RestartPolicy(max_restarts=1,
+                                              backoff=0.01),
+                         hang_timeout=30.0, poll_interval=0.1,
+                         log=lambda *_: None)
+    paths = [Path(p) for p in sup.heartbeat_files]
+    assert len(paths) == 2 and all(p.exists() for p in paths)
+    assert sup.run() == 0
+    assert not any(p.exists() for p in paths)

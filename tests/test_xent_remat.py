@@ -113,3 +113,26 @@ def test_d_ff_flows_to_init_forward_and_flops():
     wide = T.TransformerConfig(**BASE)
     assert (transformer_flops_per_token(cfg, 40)
             < transformer_flops_per_token(wide, 40))
+
+def test_mfu_n_chips_deprecated_and_conflict_raises():
+    """ADVICE r4: the deprecated `n_chips` keyword must warn, and a
+    conflicting explicit `n_devices` must raise rather than be silently
+    overridden."""
+    import warnings
+
+    import pytest
+
+    from shallowspeed_tpu.flops import mfu
+
+    cfg = T.TransformerConfig(**BASE)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = mfu(1000.0, cfg, seq_len=40, n_chips=4)
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    assert out["tflops"] > 0
+    with pytest.raises(ValueError, match="n_devices"):
+        mfu(1000.0, cfg, seq_len=40, n_devices=2, n_chips=4)
+    # agreeing values stay accepted (stale call sites passing both)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mfu(1000.0, cfg, seq_len=40, n_devices=4, n_chips=4)

@@ -794,7 +794,12 @@ def train(args) -> float:
             placed.close()
         if saver is not None:
             if sys.exc_info()[0] is None:
-                saver.close()  # drain queued writes; surface any IO error
+                # wait() is the COLLECTIVE failure-exchange point: if
+                # process 0's background write failed, every process
+                # raises here together instead of peers sailing into
+                # sample_and_print's collectives against a dying rank
+                saver.wait()
+                saver.close()  # stop the worker; surface any IO error
             else:
                 # an exception is already propagating (e.g. the divergence
                 # SystemExit with its forensic-snapshot path) — don't let a
