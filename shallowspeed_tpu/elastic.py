@@ -266,6 +266,10 @@ class GangSupervisor(Supervisor):
         t0 = time.monotonic()
         coord = self.coordinator or f"localhost:{self._free_port()}"
         children = []
+        # any exception ANYWHERE here (failed spawn, SIGINT in the
+        # monitor loop) must not leave members running: they would
+        # re-touch their heartbeat files after run()'s cleanup unlinked
+        # them, leaking both tmpfiles and orphaned training processes
         try:
             for i in range(self.n):
                 argv = list(self.argv)
@@ -280,41 +284,39 @@ class GangSupervisor(Supervisor):
                        "JAX_NUM_PROCESSES": str(self.n),
                        "JAX_PROCESS_ID": str(i)}
                 children.append(subprocess.Popen(argv, env=env))
-        except Exception:
-            # a failed spawn (ENOMEM, bad argv) must not leave the
-            # already-launched members running — they would re-touch
-            # their heartbeat files after run()'s cleanup unlinked them
+            hb_seen = [time.time()] * self.n
+            while True:
+                codes = [c.poll() for c in children]
+                if any(c is not None and c != 0 for c in codes):
+                    bad = next(i for i, c in enumerate(codes)
+                               if c is not None and c != 0)
+                    self.log(f"[elastic] gang member {bad} exited "
+                             f"{codes[bad]} — killing the gang")
+                    self._kill_gang(children)
+                    return codes[bad], time.monotonic() - t0
+                if all(c == 0 for c in codes):
+                    return 0, time.monotonic() - t0
+                if self.hang_timeout is not None:
+                    for i, hb in enumerate(self.heartbeat_files):
+                        if codes[i] == 0:
+                            continue  # finished members stop beating
+                        try:
+                            hb_seen[i] = max(hb_seen[i],
+                                             os.path.getmtime(hb))
+                        except OSError:
+                            pass
+                        stale = time.time() - hb_seen[i]
+                        if stale > self.hang_timeout:
+                            self.log(f"[elastic] gang member {i} "
+                                     f"heartbeat stale {stale:.0f}s > "
+                                     f"{self.hang_timeout}s — killing "
+                                     f"the gang")
+                            self._kill_gang(children)
+                            return -9, time.monotonic() - t0
+                time.sleep(self.poll_interval)
+        except BaseException:
             self._kill_gang(children)
             raise
-        hb_seen = [time.time()] * self.n
-        while True:
-            codes = [c.poll() for c in children]
-            if any(c is not None and c != 0 for c in codes):
-                bad = next(i for i, c in enumerate(codes)
-                           if c is not None and c != 0)
-                self.log(f"[elastic] gang member {bad} exited "
-                         f"{codes[bad]} — killing the gang")
-                self._kill_gang(children)
-                return codes[bad], time.monotonic() - t0
-            if all(c == 0 for c in codes):
-                return 0, time.monotonic() - t0
-            if self.hang_timeout is not None:
-                for i, hb in enumerate(self.heartbeat_files):
-                    if codes[i] == 0:
-                        continue  # finished members stop beating
-                    try:
-                        hb_seen[i] = max(hb_seen[i], os.path.getmtime(hb))
-                    except OSError:
-                        pass
-                    stale = time.time() - hb_seen[i]
-                    if stale > self.hang_timeout:
-                        self.log(f"[elastic] gang member {i} heartbeat "
-                                 f"stale {stale:.0f}s > "
-                                 f"{self.hang_timeout}s — killing the "
-                                 f"gang")
-                        self._kill_gang(children)
-                        return -9, time.monotonic() - t0
-            time.sleep(self.poll_interval)
 
 
 def main(argv=None) -> int:

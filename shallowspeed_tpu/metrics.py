@@ -47,3 +47,46 @@ class MetricsLogger:
         """Post-training validation accuracy — the run's headline result."""
         self.log(event="final", accuracy=round(accuracy, 6),
                  total_seconds=round(total_seconds, 3))
+
+
+class StepRates:
+    """Per-window AND cumulative training throughput, with pauses
+    (validation, checkpoint saves) excluded from both.
+
+    Round-4 lesson: logging only the cumulative average buried the
+    sustained rate — the endurance run's step lines read 0.27 MFU while
+    the true steady-state (recoverable only by offline differencing of
+    the cumulative counters) was 0.63, because early compile time never
+    leaves a cumulative denominator. The WINDOW rate — delta-tokens over
+    delta-wall-time between log points, pauses excluded — is the number
+    a sustained-MFU claim reads directly off any metrics.jsonl line; the
+    cumulative stays alongside as the whole-run summary.
+    """
+
+    def __init__(self, tokens_per_step: float, clock=time.time):
+        self.tokens_per_step = float(tokens_per_step)
+        self._clock = clock
+        self._t0 = clock()
+        self._pause = 0.0         # total excluded seconds since start
+        self._win_t = self._t0    # wall clock at the last log point
+        self._win_pause = 0.0     # excluded seconds at the last log point
+        self._steps = 0           # steps accounted across all windows
+
+    def pause(self, seconds: float) -> None:
+        """Exclude `seconds` of non-training wall time (val eval, ckpt
+        save — including an async save's caller-thread snapshot fetch,
+        which stalls the step loop for minutes on big models)."""
+        self._pause += float(seconds)
+
+    def log_point(self, steps_since_last: int) -> dict:
+        """Close the current window (`steps_since_last` training steps
+        since the previous log point) and return both rates."""
+        now = self._clock()
+        self._steps += int(steps_since_last)
+        win_secs = max(now - self._win_t
+                       - (self._pause - self._win_pause), 1e-9)
+        cum_secs = max(now - self._t0 - self._pause, 1e-9)
+        win = self.tokens_per_step * steps_since_last / win_secs
+        cum = self.tokens_per_step * self._steps / cum_secs
+        self._win_t, self._win_pause = now, self._pause
+        return {"tokens_per_sec": win, "tokens_per_sec_cum": cum}

@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 from shallowspeed_tpu.metrics import MetricsLogger
 
 
@@ -50,3 +52,53 @@ def test_zero_epoch_seconds_guard(tmp_path):
     m = MetricsLogger(p)
     m.epoch(0, 0.5, 100, 0.0)  # no ZeroDivisionError
     assert read_jsonl(p)[-1]["samples_per_sec"] == 0.0
+
+
+# ------------------------------------------------ StepRates (round 5)
+
+
+def test_step_rates_window_vs_cumulative_fake_clock():
+    """The round-4 endurance lesson, pinned: a slow first window (think
+    compile) must NOT depress later windows' rate — the window rate
+    recovers immediately while the cumulative keeps amortizing it."""
+    from shallowspeed_tpu.metrics import StepRates
+
+    t = [0.0]
+    r = StepRates(tokens_per_step=100, clock=lambda: t[0])
+    t[0] = 10.0  # 10s for the first 10 steps (compile-heavy)
+    first = r.log_point(10)
+    assert first["tokens_per_sec"] == pytest.approx(100.0)
+    assert first["tokens_per_sec_cum"] == pytest.approx(100.0)
+    t[0] = 11.0  # then 10 steps in 1s (steady state)
+    second = r.log_point(10)
+    assert second["tokens_per_sec"] == pytest.approx(1000.0)
+    # cumulative still dominated by the slow window: 2000 tok / 11 s
+    assert second["tokens_per_sec_cum"] == pytest.approx(2000 / 11)
+
+
+def test_step_rates_pauses_excluded_from_both():
+    from shallowspeed_tpu.metrics import StepRates
+
+    t = [0.0]
+    r = StepRates(tokens_per_step=10, clock=lambda: t[0])
+    t[0] = 1.0
+    r.log_point(1)                      # 10 tok in 1s
+    r.pause(5.0)                        # a checkpoint save
+    t[0] = 7.0                          # 1s of training + the 5s pause
+    out = r.log_point(1)
+    assert out["tokens_per_sec"] == pytest.approx(10.0)
+    assert out["tokens_per_sec_cum"] == pytest.approx(20 / 2.0)
+
+
+def test_step_rates_window_matches_burst_rate_zero_pause():
+    """window == cumulative when every second is training (no pauses,
+    uniform speed) — the short-fused-run sanity the VERDICT asked for."""
+    from shallowspeed_tpu.metrics import StepRates
+
+    t = [0.0]
+    r = StepRates(tokens_per_step=7, clock=lambda: t[0])
+    for k in range(1, 5):
+        t[0] = float(k)
+        out = r.log_point(1)
+        assert out["tokens_per_sec"] == pytest.approx(7.0)
+        assert out["tokens_per_sec_cum"] == pytest.approx(7.0)

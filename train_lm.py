@@ -683,8 +683,14 @@ def train(args) -> float:
                              tokenizer)
         return float("nan")
 
-    t0 = time.time()
-    val_time = 0.0  # excluded from tok/s (val syncs + compiles once)
+    from shallowspeed_tpu.metrics import StepRates
+
+    # window + cumulative tok/s with val/save time excluded from both;
+    # the WINDOW rate is what step lines and step events report first
+    # (the cumulative average buries the sustained rate under compile
+    # time — round-4 endurance lesson)
+    rates = StepRates(args.batch_size * args.seq_len)
+    last_logged = start_step - 1
     loss = float("nan")
     from shallowspeed_tpu.data.prefetch import prefetch_to_device, sync_every
     from shallowspeed_tpu.distributed import local_rows
@@ -735,31 +741,39 @@ def train(args) -> float:
                             f"loss became non-finite ({loss}) at step "
                             f"{step}; try --grad-clip, a lower --lr, or "
                             f"--lr-schedule with --warmup-steps")
-                    toks_s = (args.batch_size * args.seq_len
-                              * (step - start_step + 1)
-                              / (time.time() - t0 - val_time))
+                    r = rates.log_point(step - last_logged)
+                    last_logged = step
                     # achieved TFLOP/s + fraction-of-peak (exact matmul
                     # count per token; None off-TPU where no peak is
-                    # known). toks_s is the GLOBAL rate — divide by the
-                    # engine's mesh size, not one chip's peak.
+                    # known). Rates are GLOBAL — divide by the engine's
+                    # mesh size, not one chip's peak.
                     from shallowspeed_tpu.flops import mfu as _mfu
 
                     n_dev = getattr(getattr(engine, "mesh", None),
                                     "devices", np.zeros(1)).size
-                    perf = _mfu(toks_s, cfg, args.seq_len,
-                                dtype="bf16" if args.bf16 else "f32",
-                                n_devices=n_dev)
+                    kw = dict(dtype="bf16" if args.bf16 else "f32",
+                              n_devices=n_dev)
+                    perf = _mfu(r["tokens_per_sec"], cfg, args.seq_len,
+                                **kw)
+                    cum = _mfu(r["tokens_per_sec_cum"], cfg,
+                               args.seq_len, **kw)
                     mfu_txt = ("" if perf["mfu"] is None else
                                f"  {perf['tflops']:.1f} TF/s "
                                f"({perf['mfu'] * 100:.1f}% MFU)")
                     rprint(f"step {step:5d}  loss {loss:.4f}  "
-                           f"tok/s {toks_s:,.0f}{mfu_txt}")
+                           f"tok/s {r['tokens_per_sec']:,.0f}{mfu_txt}")
                     metrics.log(event="step", step=step,
                                 loss=round(loss, 6),
-                                tokens_per_sec=round(toks_s, 1),
+                                tokens_per_sec=round(
+                                    r["tokens_per_sec"], 1),
                                 tflops=round(perf["tflops"], 2),
                                 mfu=(None if perf["mfu"] is None
-                                     else round(perf["mfu"], 4)))
+                                     else round(perf["mfu"], 4)),
+                                tokens_per_sec_cum=round(
+                                    r["tokens_per_sec_cum"], 1),
+                                tflops_cum=round(cum["tflops"], 2),
+                                mfu_cum=(None if cum["mfu"] is None
+                                         else round(cum["mfu"], 4)))
                     if args.experts and hasattr(engine, "router_stats"):
                         # routing observability: the capacity drop is
                         # silent in the loss (ops/moe.py), so surface it
@@ -777,7 +791,7 @@ def train(args) -> float:
                     jax.block_until_ready(loss_dev)
                     tv = time.time()
                     vl = val_loss(step)
-                    val_time += time.time() - tv
+                    rates.pause(time.time() - tv)
                     rprint(f"step {step:5d}  val_loss {vl:.4f}  "
                            f"ppl {np.exp(min(vl, 20)):,.2f}")
                     metrics.log(event="val", step=step,
@@ -786,7 +800,12 @@ def train(args) -> float:
                                                  3))
                 if args.save_dir and ((step + 1) % args.save_every == 0
                                       or step == args.steps - 1):
+                    # save wall time (device->host fetch: minutes on big
+                    # models over the tunnel) must not depress the next
+                    # window's rate — round-4 endurance lesson
+                    ts = time.time()
                     save_ckpt(args.save_dir, step)
+                    rates.pause(time.time() - ts)
     finally:
         # abandoning mid-stream must not leave placed batches pinned on
         # device by a blocked producer thread
