@@ -109,6 +109,11 @@ def main():
 
     gpipe = bench_engine("gpipe", args)
     f1b1 = bench_engine("1f1b", args)
+    # ZB-H1 (round 5): hand-split B/W backward, no recompute — the win
+    # over 1f1b combines the removed per-tick forward recompute and the
+    # W-filled drain bubble (verify.simulate_zb proves the schedule
+    # half; this measures the compiled whole)
+    zb = bench_engine("zb", args)
     out = {
         "metric": "pipeline_schedule_throughput",
         "substrate": f"cpu-{args.pp}dev-virtual",
@@ -118,6 +123,9 @@ def main():
         "gpipe_tokens_per_sec": round(gpipe, 0),
         "1f1b_tokens_per_sec": round(f1b1, 0),
         "1f1b_over_gpipe": round(f1b1 / gpipe, 3),
+        "zb_tokens_per_sec": round(zb, 0),
+        "zb_over_1f1b": round(zb / f1b1, 3),
+        "zb_over_gpipe": round(zb / gpipe, 3),
     }
     if args.virtual_pp > 1 and args.n_layers % (args.pp * args.virtual_pp) == 0:
         inter = bench_engine("gpipe", args, virtual_pp=args.virtual_pp)

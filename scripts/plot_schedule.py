@@ -140,24 +140,32 @@ def interleaved_report(n_mu, pp, vpp):
 
 
 def zb_report(n_mu, pp):
-    from shallowspeed_tpu.parallel.verify import simulate_zb
+    """Rendered from `verify.zb_tables` — the EXECUTED artifact (the
+    compiled schedule="zb" engine scans these exact rows; round 5), not
+    merely the simulation it was lowered from. Stash line = the colored
+    peak across ALL three same-device pools (resb + resw residuals and
+    the B->W tap cotangents) — the engine's real buffers."""
+    from shallowspeed_tpu.parallel.verify import zb_tables
 
-    zb = simulate_zb(n_mu, pp)
+    tb = zb_tables(n_mu, pp)
 
     class _Rep:
-        makespan = zb.makespan
-        peak_stash = zb.peak_stash
+        makespan = tb.n_rounds
+        peak_stash = [tb.n_resb_slots + tb.n_resw_slots
+                      + tb.n_tap_slots] * pp
         fwd_rounds = {}
         bwd_rounds = {}
 
     rep = _Rep()
-    for (kind, l, mu), r in zb.op_rounds.items():
-        if kind == "F":
-            rep.fwd_rounds[(l, f"{mu}")] = r
-        elif kind == "B":
-            rep.bwd_rounds[(l, f"{mu}")] = r
-        else:  # W: weight-grad fill — cell renders as B<mu>w
-            rep.bwd_rounds[(l, f"{mu}w")] = r
+    for r in range(tb.n_rounds):
+        for d in range(pp):
+            op, mu = int(tb.op[r, d]), int(tb.mu[r, d])
+            if op == 1:
+                rep.fwd_rounds[(d, f"{mu}")] = r
+            elif op == 2:
+                rep.bwd_rounds[(d, f"{mu}")] = r
+            elif op == 3:  # W: weight-grad fill — cell renders as B<mu>w
+                rep.bwd_rounds[(d, f"{mu}w")] = r
     return rep
 
 

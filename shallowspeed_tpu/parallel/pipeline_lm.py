@@ -151,7 +151,37 @@ class PipelineLMEngine:
                                    ("dp", "pp", "ep")), (
             f"PipelineLMEngine expects a ('dp','pp'[,'tp'|'sp'|'ep']) "
             f"mesh, got {mesh.axis_names}")
-        assert schedule in ("gpipe", "1f1b"), schedule
+        assert schedule in ("gpipe", "1f1b", "zb"), schedule
+        if schedule == "zb":
+            # ZB-H1 (round 5): the compiled zero-bubble schedule. The
+            # hand-split B/W backward (parallel/zb.py) covers the dense
+            # collective-free block family; each exclusion below states
+            # its mechanism (pinned in tests/test_pipeline_zb.py):
+            assert mesh.axis_names == ("dp", "pp"), (
+                "schedule='zb' runs on a ('dp','pp') mesh — tp/sp/ep "
+                "put collectives inside the per-round lax.switch "
+                "branches (the same de-sync hazard 1F1B documents for "
+                "cond-gated halves)")
+            assert virtual_pp == 1, (
+                "schedule='zb' composes with vpp=1 (interleaved chunks "
+                "would need per-chunk B/W tables; not built)")
+            assert cfg.n_experts == 0, (
+                "schedule='zb' needs the dense block family (the MoE "
+                "dispatch/combine backward is not hand-split)")
+            assert cfg.dropout == 0.0 and cfg.attn_dropout == 0.0, (
+                "schedule='zb' trains without dropout (the hand-split "
+                "backward does not thread mask keys F->B)")
+            assert attn in ("xla", "flash"), (
+                "schedule='zb' supports the xla/flash substrates "
+                "(sequence stays whole inside a stage)")
+            assert not cfg.remat, (
+                "schedule='zb' IS the no-recompute schedule: it stashes "
+                "block residuals F->B by design (remat would undo the "
+                "B=1 cost the schedule needs)")
+            assert not (zero2 or fsdp), (
+                "schedule='zb' composes with plain dp / --zero1 (the "
+                "reduce-scatter substitution is not wired into the "
+                "zb scan)")
         assert virtual_pp >= 1, virtual_pp
         assert attn in ("xla", "flash", "ring", "ring-flash",
                         "ulysses-flash"), attn
@@ -1156,8 +1186,269 @@ class PipelineLMEngine:
 
             local_1f1b = local_1f1b_virtual
 
+        # ------------------------------------ ZB-H1 zero-bubble (round 5)
+        #
+        # The backward splits into B (input cotangents — critical path)
+        # and W (weight gradients — deferrable bubble filler), each at
+        # F-like cost because NOTHING is recomputed: F stashes the block
+        # residuals (parallel/zb.py), B walks the chain from the stash
+        # peeling off per-matmul output cotangents ("taps"), W turns
+        # stashed inputs x taps into weight grads as batched outer
+        # products. The schedule is `verify.simulate_zb`'s verified
+        # placement lowered to static per-round tables
+        # (`verify.zb_tables`) — schedule-as-data, exactly how the
+        # interleaved engine executes. Memory trades the 1F1B
+        # recompute-stash for full residual stashes (the ZB paper's
+        # deal); slot counts in the tables are measured peaks.
+        if self.schedule == "zb":
+            from shallowspeed_tpu.parallel import zb as ZB
+            from shallowspeed_tpu.parallel.verify import zb_tables
+
+            tbz = zb_tables(n_mu, pp)
+            zb_rows = {
+                k: jnp.asarray(getattr(tbz, k))
+                for k in ("op", "mu", "act_read", "act_write",
+                          "grad_read", "grad_write", "resb_write",
+                          "resb_read", "resw_write", "resw_read",
+                          "resw_read_b", "tap_write", "tap_read")}
+            zb_attn_fwd, zb_attn_bwd = ZB.make_attn_core(self.attn, w)
+
+            def head_sub(params_c):
+                hp = {"ln_f": params_c["ln_f"]}
+                key = "tok_emb" if cfg.tie_embeddings else "head"
+                hp[key] = params_c[key]
+                return hp
+
+            def zb_stage_fwd(params_c, x_in, tok_m, tgt_m):
+                """F: embed (stage 0), blocks with residual stashes,
+                head NLL (last stage). Same masking discipline as
+                stage_fwd; no dropout by constructor contract."""
+                s = jax.lax.axis_index("pp")
+                t = tok_m.shape[-1]
+                pos = jnp.arange(t)
+                x_own = params_c["tok_emb"][tok_m]
+                if not cfg.rope:
+                    x_own = x_own + params_c["pos_emb"][pos]
+                if cfg.compute_dtype is not None:
+                    x_own = x_own.astype(cfg.compute_dtype)
+                x0 = jnp.where(s == 0, x_own, x_in)
+                h, resb_s, resw_s = ZB.stack_fwd(
+                    params_c["blocks"], x0, pos, cfg, zb_attn_fwd)
+                hf = T._norm(params_c["ln_f"], h, cfg)
+                nll = head_nll(params_c, hf, tgt_m)
+                contrib = jnp.where(s == pp - 1, nll, 0.0)
+                return h, contrib, {"blocks": resb_s, "h": h}, resw_s
+
+            def zb_stage_bwd(params_c, resb, resw, g_rx, tok_m, tgt_m):
+                """B: head seed (last stage, via vjp — its weight grads
+                are small and land here, not in W), hand-split chain
+                through the blocks (taps out), embed backward (stage
+                0). Returns (dx_out, taps, small-grads tree)."""
+                s = jax.lax.axis_index("pp")
+                t = tok_m.shape[-1]
+                pos = jnp.arange(t)
+                h = resb["h"]
+                hp = head_sub(params_c)
+
+                def head_masked(hp_, h_):
+                    hf = T._norm(hp_["ln_f"], h_, cfg)
+                    nll = head_nll(hp_, hf, tgt_m)
+                    return jnp.where(s == pp - 1, nll, 0.0)
+
+                _, pb = jax.vjp(head_masked, hp, h)
+                dhp, dh_head = pb(_pvary(jnp.float32(1.0 / n_mu),
+                                         vary_axes))
+                dh = dh_head + jnp.where(s == pp - 1,
+                                         jnp.zeros_like(g_rx), g_rx)
+                dx0, taps, dnorm_s = ZB.stack_bwd_x(
+                    params_c["blocks"], resb["blocks"], resw, dh, pos,
+                    cfg, zb_attn_bwd)
+
+                def emb_masked(ep):
+                    x_own = ep["tok_emb"][tok_m]
+                    if not cfg.rope:
+                        x_own = x_own + ep["pos_emb"][pos]
+                    if cfg.compute_dtype is not None:
+                        x_own = x_own.astype(cfg.compute_dtype)
+                    return jnp.where(s == 0, x_own, 0.0)
+
+                _, pbe = jax.vjp(
+                    emb_masked, {"tok_emb": params_c["tok_emb"],
+                                 "pos_emb": params_c["pos_emb"]})
+                (demb,) = pbe(dx0)
+                dx_out = jnp.where(s == 0, jnp.zeros_like(dx0), dx0)
+                z = tree_map(jnp.zeros_like, params_c)
+                dsmall = dict(z)
+                dsmall["blocks"] = {**z["blocks"],
+                                    "ln1": dnorm_s["ln1"],
+                                    "ln2": dnorm_s["ln2"]}
+                dsmall["ln_f"] = dhp["ln_f"]
+                if cfg.tie_embeddings:
+                    dsmall["tok_emb"] = (demb["tok_emb"]
+                                         + dhp["tok_emb"])
+                else:
+                    dsmall["tok_emb"] = demb["tok_emb"]
+                    dsmall["head"] = dhp["head"]
+                dsmall["pos_emb"] = demb["pos_emb"]
+                return dx_out, taps, dsmall
+
+            def local_zb(params, tokens, targets, key=None,
+                         grad_reduce=None):
+                """The compiled ZB-H1 batch step (inside shard_map): a
+                scan over the verified schedule's rounds, one op per
+                device per round, activations hopping right and
+                cotangents left every round (slot-buffered); same
+                (loss, grads) contract as local_1f1b."""
+                s = jax.lax.axis_index("pp")
+                params_c = _pvary(
+                    T.cast_params(params, cfg.compute_dtype), vary_axes)
+                mubs, t = tokens.shape[1], tokens.shape[2]
+                dt = cfg.compute_dtype or cfg.dtype
+                act_shape = (mubs, t, cfg.d_model)
+                pos0 = jnp.arange(t)
+
+                # stash templates via abstract evaluation of the pure
+                # stack fns (no tracing cost — shapes only)
+                x0s = jax.ShapeDtypeStruct(act_shape, dt)
+                _, resb_sh, resw_sh = jax.eval_shape(
+                    lambda bl, x: ZB.stack_fwd(
+                        bl, _pvary(x, vary_axes), pos0, cfg,
+                        zb_attn_fwd),
+                    params_c["blocks"], x0s)
+                _, taps_sh, _ = jax.eval_shape(
+                    lambda bl, rb, rw, g: ZB.stack_bwd_x(
+                        bl, rb, rw, _pvary(g, vary_axes), pos0, cfg,
+                        zb_attn_bwd),
+                    params_c["blocks"], resb_sh, resw_sh, x0s)
+                resb_full_sh = {"blocks": resb_sh,
+                                "h": jax.ShapeDtypeStruct(act_shape,
+                                                          dt)}
+
+                def zeros_of(sh_tree, slots=None):
+                    lead = () if slots is None else (slots,)
+                    return tree_map(
+                        lambda sh: jnp.zeros(lead + sh.shape, sh.dtype),
+                        sh_tree)
+
+                def zeros_act():
+                    return jnp.zeros(act_shape, dt)
+
+                def round_fn(carry, row):
+                    (act_buf, grad_buf, resb_buf, resw_buf, tap_buf,
+                     grads, loss_acc) = carry
+                    op = jnp.take(row["op"], s)
+                    m = jnp.take(row["mu"], s)
+                    tok_m = jax.lax.dynamic_index_in_dim(tokens, m, 0,
+                                                         False)
+                    tgt_m = jax.lax.dynamic_index_in_dim(targets, m, 0,
+                                                         False)
+                    x_in = jax.lax.dynamic_index_in_dim(
+                        act_buf, jnp.take(row["act_read"], s), 0, False)
+                    g_rx = jax.lax.dynamic_index_in_dim(
+                        grad_buf, jnp.take(row["grad_read"], s), 0,
+                        False)
+                    resb_in = tree_map(
+                        lambda b: jax.lax.dynamic_index_in_dim(
+                            b, jnp.take(row["resb_read"], s), 0, False),
+                        resb_buf)
+                    resw_in_b = tree_map(
+                        lambda b: jax.lax.dynamic_index_in_dim(
+                            b, jnp.take(row["resw_read_b"], s), 0,
+                            False), resw_buf)
+                    resw_in_w = tree_map(
+                        lambda b: jax.lax.dynamic_index_in_dim(
+                            b, jnp.take(row["resw_read"], s), 0, False),
+                        resw_buf)
+                    tap_in = tree_map(
+                        lambda b: jax.lax.dynamic_index_in_dim(
+                            b, jnp.take(row["tap_read"], s), 0, False),
+                        tap_buf)
+
+                    def zero_out():
+                        return _pvary(
+                            (zeros_act(), zeros_act(),
+                             tree_map(jnp.zeros_like, params_c),
+                             jnp.float32(0.0), zeros_of(resb_full_sh),
+                             zeros_of(resw_sh), zeros_of(taps_sh)),
+                            vary_axes)
+
+                    def do_idle():
+                        return zero_out()
+
+                    def do_f():
+                        h, contrib, resb_e, resw_e = zb_stage_fwd(
+                            params_c, x_in, tok_m, tgt_m)
+                        z = zero_out()
+                        return (h, z[1], z[2], contrib, resb_e, resw_e,
+                                z[6])
+
+                    def do_b():
+                        dx, taps, dsmall = zb_stage_bwd(
+                            params_c, resb_in, resw_in_b, g_rx, tok_m,
+                            tgt_m)
+                        z = zero_out()
+                        return (z[0], dx, dsmall, z[3], z[4], z[5],
+                                taps)
+
+                    def do_w():
+                        dense = ZB.stack_bwd_w(resw_in_w, tap_in, cfg)
+                        z = zero_out()
+                        dgr = dict(z[2])
+                        dgr["blocks"] = {**z[2]["blocks"], **dense}
+                        return (z[0], z[1], dgr, z[3], z[4], z[5],
+                                z[6])
+
+                    (out_act, out_grad, dgrads, contrib, resb_e,
+                     resw_e, tap_e) = jax.lax.switch(
+                        op, [do_idle, do_f, do_b, do_w])
+                    grads = tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), grads,
+                        dgrads)
+                    loss_acc = loss_acc + contrib
+                    x_next = jax.lax.ppermute(out_act, "pp", right)
+                    g_next = jax.lax.ppermute(out_grad, "pp", left)
+                    act_buf = jax.lax.dynamic_update_index_in_dim(
+                        act_buf, x_next, jnp.take(row["act_write"], s),
+                        0)
+                    grad_buf = jax.lax.dynamic_update_index_in_dim(
+                        grad_buf, g_next,
+                        jnp.take(row["grad_write"], s), 0)
+                    resb_buf = tree_map(
+                        lambda b, e: jax.lax.dynamic_update_index_in_dim(
+                            b, e, jnp.take(row["resb_write"], s), 0),
+                        resb_buf, resb_e)
+                    resw_buf = tree_map(
+                        lambda b, e: jax.lax.dynamic_update_index_in_dim(
+                            b, e, jnp.take(row["resw_write"], s), 0),
+                        resw_buf, resw_e)
+                    tap_buf = tree_map(
+                        lambda b, e: jax.lax.dynamic_update_index_in_dim(
+                            b, e, jnp.take(row["tap_write"], s), 0),
+                        tap_buf, tap_e)
+                    return (act_buf, grad_buf, resb_buf, resw_buf,
+                            tap_buf, grads, loss_acc), None
+
+                init = _pvary(
+                    (jnp.zeros((tbz.n_act_slots + 1,) + act_shape, dt),
+                     jnp.zeros((tbz.n_grad_slots + 1,) + act_shape, dt),
+                     zeros_of(resb_full_sh, tbz.n_resb_slots + 1),
+                     zeros_of(resw_sh, tbz.n_resw_slots + 1),
+                     zeros_of(taps_sh, tbz.n_tap_slots + 1),
+                     tree_map(lambda le: jnp.zeros_like(le,
+                                                        jnp.float32),
+                              params),
+                     jnp.float32(0.0)),
+                    vary_axes)
+                (_, _, _, _, _, grads, loss_sum), _ = jax.lax.scan(
+                    round_fn, init, zb_rows)
+                grads = (grad_reduce or reduce_plain)(grads)
+                loss = jax.lax.psum(loss_sum, "pp") / n_mu
+                return loss, grads
+
+            local_1f1b = local_zb
+
         pspecs, ospecs = self._pspecs, self._opt_specs
-        use_1f1b = self.schedule == "1f1b"
+        use_1f1b = self.schedule in ("1f1b", "zb")
         seed = self._seed
         # data specs: microbatch axis unsharded, rows over dp (and over
         # ep when the mesh has one — ep multiplies the data dimension),

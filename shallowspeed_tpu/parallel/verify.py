@@ -600,3 +600,143 @@ def simulate_zb(num_micro_batches: int, pp: int) -> ZBReport:
     return ZBReport(makespan=zb_makespan, f1b1_makespan=f_makespan,
                     bubble=zb_bubble, f1b1_bubble=f_bubble,
                     peak_stash=zb_peak, op_rounds=zb_starts)
+
+
+@dataclass
+class ZBTables:
+    """The verified ZB-H1 schedule lowered to STATIC per-round arrays a
+    compiled `lax.scan` follows (pipeline_lm's schedule="zb" engine) —
+    the same schedule-as-data lowering `interleaved_tables` does for
+    vpp x 1f1b, extended with the W op and its two extra stash pools.
+
+    Round semantics: each device executes at most ONE op per round
+    (op[r, d]: 0 idle, 1 F, 2 B, 3 W) on microbatch mu[r, d]; afterwards
+    activations hop right and cotangents hop left (unconditional
+    ppermutes), arrivals routed via act_write/grad_write (trash slot =
+    n_*_slots absorbs empty rounds). Stash pools, all same-device:
+
+    - resb (written at F, read at B): the residuals only the input-
+      cotangent pass needs (q/k/v, attention out + lse, norm stats,
+      block inputs) — freed as soon as B runs;
+    - resw (written at F, read at W): the per-matmul INPUT activations
+      the weight-gradient pass needs (h1, a, h2, ffn pre-acts) — live
+      until W;
+    - tap (written at B, read at W): the per-matmul OUTPUT cotangents B
+      peels off while walking the chain.
+
+    Slot counts come from greedy interval coloring of the verified
+    schedule's lifetimes, so they are measured peaks, not guesses."""
+
+    n_rounds: int
+    n_act_slots: int
+    n_grad_slots: int
+    n_resb_slots: int
+    n_resw_slots: int
+    n_tap_slots: int
+    op: "object"          # all arrays: int32 (n_rounds, pp)
+    mu: "object"
+    act_read: "object"
+    act_write: "object"
+    grad_read: "object"
+    grad_write: "object"
+    resb_write: "object"
+    resb_read: "object"
+    resw_write: "object"
+    resw_read: "object"      # read by W
+    resw_read_b: "object"    # read by B (o / ffn pre-acts feed both passes)
+    tap_write: "object"
+    tap_read: "object"
+
+
+def zb_tables(num_micro_batches: int, pp: int) -> ZBTables:
+    """Lower the ZB-H1 schedule `simulate_zb` verifies into the static
+    per-round tables the compiled engine follows. The op placement IS
+    `simulate_zb(...).op_rounds` (split form) — what executes is what
+    the simulator proved; this function only adds the message/stash slot
+    bookkeeping."""
+    import numpy as np
+
+    n_mu = num_micro_batches
+    rep = simulate_zb(n_mu, pp)
+    starts = rep.op_rounds
+    rounds = rep.makespan
+
+    f_round = {(l, m): r for (k, l, m), r in starts.items() if k == "F"}
+    b_round = {(l, m): r for (k, l, m), r in starts.items() if k == "B"}
+    w_round = {(l, m): r for (k, l, m), r in starts.items() if k == "W"}
+
+    act_msgs = [[] for _ in range(pp)]   # consumer-device intervals
+    grad_msgs = [[] for _ in range(pp)]
+    resb_items = [[] for _ in range(pp)]
+    resw_items = [[] for _ in range(pp)]
+    tap_items = [[] for _ in range(pp)]
+    for (l, m), r_p in f_round.items():
+        if l < pp - 1:
+            act_msgs[l + 1].append(((l + 1, m), r_p, f_round[(l + 1, m)]))
+        resb_items[l].append(((l, m), r_p, b_round[(l, m)]))
+        resw_items[l].append(((l, m), r_p, w_round[(l, m)]))
+    for (l, m), r_p in b_round.items():
+        if l > 0:
+            grad_msgs[l - 1].append(((l - 1, m), r_p,
+                                     b_round[(l - 1, m)]))
+        tap_items[l].append(((l, m), r_p, w_round[(l, m)]))
+
+    assigns = []
+    counts = []
+    for items in (act_msgs, grad_msgs, resb_items, resw_items,
+                  tap_items):
+        assign, n = {}, 0
+        for d in range(pp):
+            a, na = _color_intervals(items[d])
+            assign.update(a)
+            n = max(n, na)
+        assigns.append(assign)
+        counts.append(n)
+    act_a, grad_a, resb_a, resw_a, tap_a = assigns
+    n_act, n_grad, n_resb, n_resw, n_tap = counts
+
+    op_t = np.zeros((rounds, pp), np.int32)
+    mu_t = np.zeros((rounds, pp), np.int32)
+    act_r = np.full((rounds, pp), n_act, np.int32)
+    act_w = np.full((rounds, pp), n_act, np.int32)
+    grad_r = np.full((rounds, pp), n_grad, np.int32)
+    grad_w = np.full((rounds, pp), n_grad, np.int32)
+    resb_w = np.full((rounds, pp), n_resb, np.int32)
+    resb_r = np.full((rounds, pp), n_resb, np.int32)
+    resw_w = np.full((rounds, pp), n_resw, np.int32)
+    resw_r = np.full((rounds, pp), n_resw, np.int32)
+    resw_rb = np.full((rounds, pp), n_resw, np.int32)
+    tap_w = np.full((rounds, pp), n_tap, np.int32)
+    tap_r = np.full((rounds, pp), n_tap, np.int32)
+    code = {"F": 1, "B": 2, "W": 3}
+    for (kind, l, m), r in starts.items():
+        assert op_t[r, l] == 0, (
+            f"device {l} double-booked at round {r}")
+        op_t[r, l] = code[kind]
+        mu_t[r, l] = m
+        if kind == "F":
+            if l > 0:
+                act_r[r, l] = act_a[(l, m)]
+            resb_w[r, l] = resb_a[(l, m)]
+            resw_w[r, l] = resw_a[(l, m)]
+            if l < pp - 1:
+                act_w[r, l + 1] = act_a[(l + 1, m)]
+        elif kind == "B":
+            if l < pp - 1:
+                grad_r[r, l] = grad_a[(l, m)]
+            resb_r[r, l] = resb_a[(l, m)]
+            resw_rb[r, l] = resw_a[(l, m)]
+            tap_w[r, l] = tap_a[(l, m)]
+            if l > 0:
+                grad_w[r, l - 1] = grad_a[(l - 1, m)]
+        else:
+            resw_r[r, l] = resw_a[(l, m)]
+            tap_r[r, l] = tap_a[(l, m)]
+
+    return ZBTables(
+        n_rounds=rounds, n_act_slots=n_act, n_grad_slots=n_grad,
+        n_resb_slots=n_resb, n_resw_slots=n_resw, n_tap_slots=n_tap,
+        op=op_t, mu=mu_t, act_read=act_r, act_write=act_w,
+        grad_read=grad_r, grad_write=grad_w, resb_write=resb_w,
+        resb_read=resb_r, resw_write=resw_w, resw_read=resw_r,
+        resw_read_b=resw_rb, tap_write=tap_w, tap_read=tap_r)

@@ -87,3 +87,18 @@ def test_resume_requires_save_dir():
 # out-of-window tiles, ring/ulysses mask by global position) — the old
 # rejection tests are gone; composition is covered by
 # tests/test_attention.py / test_flash_attention.py window parity.
+
+
+def test_zb_schedule_guards():
+    """--pp-schedule zb (round 5): every carve-out exits labeled, in CLI
+    vocabulary, mirroring PipelineLMEngine's pinned asserts."""
+    base = ["--pp", "2", "--pp-schedule", "zb"]
+    expect_exit(base + ["--tp", "2"], "'dp','pp'")
+    expect_exit(base + ["--sp", "2", "--attn", "ring"], "'dp','pp'")
+    expect_exit(base + ["--ep", "2", "--experts", "2"], "'dp','pp'")
+    expect_exit(base + ["--virtual-pp", "2"], "--virtual-pp 1")
+    expect_exit(base + ["--experts", "2"], "dense block family")
+    expect_exit(base + ["--dropout", "0.1"], "without dropout")
+    expect_exit(base + ["--remat"], "no-recompute")
+    expect_exit(["--dp", "2"] + base + ["--zero2"], "--zero1")
+    expect_exit(["--dp", "2"] + base + ["--fsdp"], "--zero1")

@@ -1,0 +1,208 @@
+"""Compiled ZB-H1 zero-bubble schedule (`parallel/pipeline_lm.py`,
+`schedule="zb"`; split backward in `parallel/zb.py`; tables in
+`verify.zb_tables`).
+
+Round-4's pinned decision said the compiled form loses while a dw-only
+vjp must re-run the forward; round 5 hand-writes the per-block dW/dx
+split, flipping the decision (see test_schedule_verify.py). Oracles:
+the same gradient-sum equivalence every schedule is held to (gpipe /
+plain-dp trajectory parity), plus a pure-python replay that the static
+tables execute the exact schedule `simulate_zb` verified.
+"""
+
+from dataclasses import replace
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.optim import SGD, Adam
+from shallowspeed_tpu.parallel.context import ContextParallelEngine
+from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+from shallowspeed_tpu.parallel.verify import simulate_zb, zb_tables
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=4,
+                          max_seq=32)
+
+
+def pp_mesh(dp, pp):
+    devs = np.array(jax.devices()[: dp * pp]).reshape(dp, pp)
+    return Mesh(devs, ("dp", "pp"))
+
+
+def batch(seed=0, b=8, t=32, vocab=64):
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+# ------------------------------------------------------- tables replay
+
+
+@pytest.mark.parametrize("n_mu,pp", [(4, 2), (8, 4), (12, 3)])
+def test_zb_tables_replay(n_mu, pp):
+    """Pure-python execution of the static tables: every F/B/W runs
+    exactly once, every read sees the matching write (act/grad messages
+    and all three stash pools), and the round count IS the simulator's
+    verified makespan."""
+    tb = zb_tables(n_mu, pp)
+    rep = simulate_zb(n_mu, pp)
+    assert tb.n_rounds == rep.makespan
+
+    act = [[None] * (tb.n_act_slots + 1) for _ in range(pp)]
+    grad = [[None] * (tb.n_grad_slots + 1) for _ in range(pp)]
+    resb = [[None] * (tb.n_resb_slots + 1) for _ in range(pp)]
+    resw = [[None] * (tb.n_resw_slots + 1) for _ in range(pp)]
+    tap = [[None] * (tb.n_tap_slots + 1) for _ in range(pp)]
+    seen = {"F": set(), "B": set(), "W": set()}
+    for r in range(tb.n_rounds):
+        out_act = [None] * pp
+        out_grad = [None] * pp
+        for d in range(pp):
+            op, m = tb.op[r, d], tb.mu[r, d]
+            if op == 1:                                   # F
+                if d > 0:
+                    assert act[d][tb.act_read[r, d]] == ("act", d, m), \
+                        (r, d, m)
+                resb[d][tb.resb_write[r, d]] = ("resb", d, m)
+                resw[d][tb.resw_write[r, d]] = ("resw", d, m)
+                out_act[d] = ("act", d + 1, m)
+                seen["F"].add((d, m))
+            elif op == 2:                                 # B
+                if d < pp - 1:
+                    assert grad[d][tb.grad_read[r, d]] == \
+                        ("grad", d, m), (r, d, m)
+                assert resb[d][tb.resb_read[r, d]] == ("resb", d, m)
+                assert resw[d][tb.resw_read_b[r, d]] == ("resw", d, m)
+                tap[d][tb.tap_write[r, d]] = ("tap", d, m)
+                out_grad[d] = ("grad", d - 1, m)
+                seen["B"].add((d, m))
+            elif op == 3:                                 # W
+                assert resw[d][tb.resw_read[r, d]] == ("resw", d, m)
+                assert tap[d][tb.tap_read[r, d]] == ("tap", d, m)
+                seen["W"].add((d, m))
+        for d in range(pp):                               # the hops
+            src = out_act[(d - 1) % pp]
+            act[d][tb.act_write[r, d]] = src
+            srcg = out_grad[(d + 1) % pp]
+            grad[d][tb.grad_write[r, d]] = srcg
+    full = {(d, m) for d in range(pp) for m in range(n_mu)}
+    assert seen["F"] == full and seen["B"] == full and seen["W"] == full
+
+
+def test_zb_beats_1f1b_makespan_at_flagship_size():
+    """The VERDICT's bar: a makespan/bubble win at pp=4, n_mu >= 8 —
+    now from the COMPILED tables (what executes), not just the sim."""
+    tb = zb_tables(8, 4)
+    rep = simulate_zb(8, 4)
+    assert tb.n_rounds < rep.f1b1_makespan
+    assert rep.bubble < rep.f1b1_bubble
+
+
+# ---------------------------------------------------------- equivalence
+
+
+@pytest.mark.parametrize("dp,pp,n_mu", [(1, 4, 8), (2, 2, 4), (1, 2, 6),
+                                        (2, 4, 2)])
+def test_zb_matches_plain_dp(dp, pp, n_mu):
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "sp"))
+    ref = ContextParallelEngine(CFG, SGD(0.1), mesh, seed=0)
+    eng = PipelineLMEngine(CFG, SGD(0.1), pp_mesh(dp, pp),
+                           n_mubatches=n_mu, seed=0, schedule="zb")
+    for step in range(4):
+        tok, tgt = batch(step, b=8 if n_mu != 6 else 24)
+        lr_ = ref.train_batch(tok, tgt)
+        lz = eng.train_batch(tok, tgt)
+        assert lz == pytest.approx(lr_, rel=3e-4), (step, dp, pp, n_mu)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(eng.get_canonical_params()),
+            jax.tree_util.tree_leaves(ref.get_canonical_params())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
+@pytest.mark.parametrize("kw,attn", [
+    (dict(), "xla"),
+    (dict(norm="rmsnorm", ffn="swiglu", rope=True), "xla"),
+    (dict(n_kv_heads=2, rope=True), "flash"),
+    (dict(attn_window=16), "flash"),
+    (dict(tie_embeddings=True, xent_chunk=64), "xla"),
+])
+def test_zb_matches_gpipe_exactly(kw, attn):
+    """Same engine, same data placement, two schedules: agreement to
+    float reassociation tolerance across the block-option matrix the
+    split backward supports."""
+    cfg = replace(CFG, **kw)
+    g = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(1, 4), n_mubatches=8,
+                         seed=0, schedule="gpipe", attn=attn)
+    z = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(1, 4), n_mubatches=8,
+                         seed=0, schedule="zb", attn=attn)
+    for step in range(3):
+        tok, tgt = batch(step)
+        assert z.train_batch(tok, tgt) == pytest.approx(
+            g.train_batch(tok, tgt), rel=1e-5), (step, kw, attn)
+
+
+def test_zb_zero1_matches_dense():
+    """ZeRO-1 composes: the update program shards moments over dp while
+    the zb gradient program is unchanged."""
+    g = PipelineLMEngine(CFG, Adam(1e-2), pp_mesh(2, 2), n_mubatches=4,
+                         seed=0, schedule="zb")
+    z = PipelineLMEngine(CFG, Adam(1e-2), pp_mesh(2, 2), n_mubatches=4,
+                         seed=0, schedule="zb", zero1=True)
+    for step in range(3):
+        tok, tgt = batch(step)
+        assert z.train_batch(tok, tgt) == pytest.approx(
+            g.train_batch(tok, tgt), rel=1e-5), step
+
+
+def test_zb_bf16_trains():
+    cfg = replace(CFG, dtype=np.float32,
+                  compute_dtype=np.dtype("bfloat16"))
+    eng = PipelineLMEngine(cfg, SGD(0.1), pp_mesh(1, 2), n_mubatches=4,
+                           seed=0, schedule="zb")
+    tok, tgt = batch(0)
+    first = eng.train_batch(tok, tgt)
+    for step in range(1, 4):
+        tok, tgt = batch(0)
+        last = eng.train_batch(tok, tgt)
+    assert np.isfinite(first) and last < first
+
+
+# ------------------------------------------------- pinned carve-outs
+
+
+@pytest.mark.parametrize("build", [
+    lambda: PipelineLMEngine(
+        CFG, SGD(0.1),
+        Mesh(np.array(jax.devices()[:4]).reshape(1, 2, 2),
+             ("dp", "pp", "tp")), n_mubatches=2, schedule="zb"),
+    lambda: PipelineLMEngine(
+        CFG, SGD(0.1),
+        Mesh(np.array(jax.devices()[:4]).reshape(1, 2, 2),
+             ("dp", "pp", "sp")), n_mubatches=2, schedule="zb",
+        attn="ring"),
+    lambda: PipelineLMEngine(CFG, SGD(0.1), pp_mesh(1, 2),
+                             n_mubatches=2, schedule="zb",
+                             virtual_pp=2),
+    lambda: PipelineLMEngine(replace(CFG, n_experts=4), SGD(0.1),
+                             pp_mesh(1, 2), n_mubatches=2,
+                             schedule="zb"),
+    lambda: PipelineLMEngine(replace(CFG, dropout=0.1), SGD(0.1),
+                             pp_mesh(1, 2), n_mubatches=2,
+                             schedule="zb"),
+    lambda: PipelineLMEngine(replace(CFG, remat=True), SGD(0.1),
+                             pp_mesh(1, 2), n_mubatches=2,
+                             schedule="zb"),
+    lambda: PipelineLMEngine(CFG, SGD(0.1), pp_mesh(2, 2),
+                             n_mubatches=2, schedule="zb", zero2=True),
+    lambda: PipelineLMEngine(CFG, SGD(0.1), pp_mesh(2, 2),
+                             n_mubatches=2, schedule="zb", fsdp=True),
+])
+def test_zb_carveouts_are_pinned(build):
+    """Every constructor exclusion fails fast with its mechanism named
+    (the executable-negative-decision style the ZB lineage set)."""
+    with pytest.raises(AssertionError, match="zb"):
+        build()

@@ -252,15 +252,22 @@ def test_zb_h1_beats_1f1b_at_equal_cost(n_mu, pp):
     assert max(r.peak_stash) <= 2 * min(pp, n_mu), r.peak_stash
 
 
-def test_zb_h1_compile_decision_is_negative():
-    """The COMPILED form is deliberately not built (VERDICT r3 item 10:
-    'compiled only if the simulation says it wins'): in JAX, a
-    dw-only vjp re-runs the forward, so the expressible split costs
-    F=1, B=2, W=2 against 1F1B's fused 3 — and at practical
-    microbatch counts (n_mu >= 2*pp, amortizing the bubble) that LOSES.
-    This test pins the decision experiment so the reasoning stays
-    executable; a hand-written per-block dW path (no recompute in W)
-    is what would flip it."""
+def test_zb_h1_compile_decision_flipped():
+    """Round 4 pinned the compile decision NEGATIVE: a JAX-expressible
+    dw-only vjp re-runs the forward (F=1, B=2, W=2 vs 1F1B's fused 3),
+    which loses at practical microbatch counts — and named its flip
+    condition: a hand-written per-block dW path with no recompute in
+    either half. Round 5 built exactly that (`parallel/zb.py`: B walks
+    stashed residuals, W is batched outer products), so the decision
+    FLIPS and this test pins both sides:
+
+    1. the recompute-cost form still loses (the round-4 experiment
+       stays executable — if JAX someday makes dw-only vjp free, this
+       half fails and the hand-split can be retired);
+    2. the hand-split's F=1, B=1, W=1 form wins and is what the engine
+       compiles (`PipelineLMEngine(schedule="zb")` executes
+       `zb_tables`' lowering of this exact simulation —
+       tests/test_pipeline_zb.py holds the replay + parity oracles)."""
     import inspect
 
     import shallowspeed_tpu.parallel.verify as V
@@ -280,4 +287,9 @@ def test_zb_h1_compile_decision_is_negative():
         r = ns["simulate_zb"](n_mu, pp)
         assert r.makespan >= r.f1b1_makespan, (
             "the +1-forward ZB form now WINS at practical sizes — "
-            "revisit compiling it", n_mu, pp)
+            "the hand-split may be retirable", n_mu, pp)
+        # the no-recompute split (what parallel/zb.py implements) wins
+        # at the same sizes, and its lowering is what compiles
+        real = V.simulate_zb(n_mu, pp)
+        assert real.makespan < real.f1b1_makespan, (n_mu, pp)
+        assert V.zb_tables(n_mu, pp).n_rounds == real.makespan

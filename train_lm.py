@@ -32,11 +32,14 @@ def parse_args(argv=None):
                    help="pipeline-parallel degree: GPipe over transformer "
                         "blocks, backward schedule derived by autodiff "
                         "(needs n_layers %% pp == 0)")
-    p.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+    p.add_argument("--pp-schedule", choices=["gpipe", "1f1b", "zb"],
                    default="gpipe",
                    help="compiled pipeline schedule: gpipe (autodiff "
-                        "backward) or 1f1b (PipeDream-Flush: bounded "
-                        "min(pp, n_mu) activation stash)")
+                        "backward), 1f1b (PipeDream-Flush: bounded "
+                        "min(pp, n_mu) activation stash), or zb "
+                        "(ZB-H1 zero-bubble: hand-split B/W backward, "
+                        "deferred weight grads fill the drain bubble; "
+                        "full residual stash, no recompute)")
     p.add_argument("--virtual-pp", type=int, default=1,
                    help="interleaved virtual pipeline stages per device "
                         "(Megatron-style; gpipe schedule, needs "
@@ -392,6 +395,32 @@ def train(args) -> float:
         raise SystemExit(f"--attn {args.attn} is not available with --pp "
                          "(XLA attention by default, or the fused Pallas "
                          "kernel via --attn flash)")
+    if args.pp > 1 and args.pp_schedule == "zb":
+        # mirror of PipelineLMEngine's pinned zb carve-outs, with CLI
+        # vocabulary (tests/test_pipeline_zb.py pins the mechanisms);
+        # gated on pp > 1 like every sibling check — at pp=1 the
+        # schedule flag is inert (no pipeline engine is built)
+        if any(a > 1 for a in (args.tp, args.sp, args.ep)):
+            raise SystemExit("--pp-schedule zb runs on a ('dp','pp') "
+                             "mesh (no --tp/--sp/--ep: collectives "
+                             "inside the per-round switch de-sync)")
+        if args.virtual_pp > 1:
+            raise SystemExit("--pp-schedule zb needs --virtual-pp 1 "
+                             "(per-chunk B/W tables are not built)")
+        if args.experts:
+            raise SystemExit("--pp-schedule zb needs the dense block "
+                             "family (no --experts)")
+        if args.dropout > 0.0 or args.attn_dropout > 0.0:
+            raise SystemExit("--pp-schedule zb trains without dropout "
+                             "(the hand-split backward does not thread "
+                             "mask keys F->B)")
+        if args.remat:
+            raise SystemExit("--pp-schedule zb IS the no-recompute "
+                             "schedule (it stashes residuals F->B); "
+                             "drop --remat")
+        if args.zero2 or args.fsdp:
+            raise SystemExit("--pp-schedule zb composes with plain dp "
+                             "or --zero1 (no --zero2/--fsdp)")
     if args.ep > 1 and args.tp > 1:
         raise SystemExit("--ep composes with --dp/--sp (not --tp)")
     if args.keep_checkpoints < 0:
