@@ -78,6 +78,11 @@ from jax.experimental import pallas as pl
 
 _NEG = -1e30  # plain float: jnp scalars would be captured consts in kernels
 _LANES = 128  # Mosaic min lane width: row stats (lse/delta) pad to this
+# Default kernel tile sizes (auto-shrunk per sequence by _pick_block).
+# Exported so out-of-module replay paths (parallel/zb.py's split
+# backward) tile identically to every in-module entry point.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 
 
 def _interpret_default() -> bool:
@@ -655,7 +660,8 @@ def _delta_of(do3, o3, like_lse):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True, window: int = 0,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool | None = None):
     """Fused multi-head attention; same contract as `ops.attention`.
 
@@ -772,8 +778,8 @@ def _ring_geometry(q, k):
     b, t, h, d = q.shape
     kvh = k.shape[2]
     assert h % kvh == 0, (h, kvh)
-    bq = _pick_block(t, 512)
-    bk = _pick_block(k.shape[1], 512)
+    bq = _pick_block(t, DEFAULT_BLOCK_Q)
+    bk = _pick_block(k.shape[1], DEFAULT_BLOCK_K)
     return b, t, h, d, kvh, h // kvh, bq, bk, t // bq
 
 

@@ -263,14 +263,21 @@ class PipelineLMEngine:
             # tp composes (round 4): the dp reduce-scatter/all-gather
             # acts on each leaf's ZeRO dim while tp reductions stay
             # with variance-typed autodiff, and zero2_grad_specs picks
-            # a free (non-'pp'/'tp') dim per leaf. sp/ep stay out: their
-            # uniform-execution 1F1B path hands raw per-device partials
-            # to a single post-scan reduction whose shape the
-            # reduce-scatter substitution does not yet cover.
-            assert not self.has_sp and not self.has_ep and \
-                virtual_pp == 1, (
-                    "zero2/fsdp x pp support ('dp','pp'[,'tp']) meshes "
-                    "(no sp/ep axis, no virtual stages)")
+            # a free (non-'pp'/'tp') dim per leaf. sp composes (round
+            # 5): the uniform-execution 1F1B path's post-scan partials
+            # reduce per leaf over grad_psum_axes minus 'dp' (the 'sp'
+            # sum) before the dp reduce-scatter — the same per-leaf
+            # shape as the tp case. Virtual stages compose too (the
+            # interleaved scan takes the same grad_reduce
+            # substitution). ep stays out: expert leaves' grads are
+            # ep-SHARDED (not ep-partial), so the ZeRO dim choice and
+            # the scatter would have to be expert-aware
+            # (tests/test_zero2.py pins this decision).
+            assert not self.has_ep, (
+                "zero2/fsdp x pp support ('dp','pp'[,'tp'|'sp']) "
+                "meshes and virtual stages (no ep axis: expert-leaf "
+                "grads are ep-sharded, which the per-leaf ZeRO "
+                "dim/scatter rule does not describe)")
         self.n_mu = n_mubatches
         self.l_local = cfg.n_layers // self.pp
         self.optimizer = optimizer
@@ -1078,12 +1085,14 @@ class PipelineLMEngine:
                 contrib = jnp.where(l == depth_v - 1, nll, 0.0) + aux
                 return h, contrib
 
-            def local_1f1b_virtual(params, tokens, targets, key=None):
+            def local_1f1b_virtual(params, tokens, targets, key=None,
+                                   grad_reduce=None):
                 """Interleaved PipeDream-Flush batch step (inside
                 shard_map): a scan over the schedule's rounds, each
                 executing this device's table entry. Returns
                 (local-mean loss, accumulated f32 grads) like
-                local_1f1b."""
+                local_1f1b (including the `grad_reduce` substitution
+                the ZeRO-2/FSDP path uses — round 5)."""
                 s = jax.lax.axis_index("pp")
                 params_c = _pvary(
                     T.cast_params(params, cfg.compute_dtype),
@@ -1180,7 +1189,7 @@ class PipelineLMEngine:
                     ("dp", "pp"))
                 (_, _, _, grads, loss_sum), _ = jax.lax.scan(
                     round_fn, init, tb_rows)
-                grads = reduce_plain(grads)
+                grads = (grad_reduce or reduce_plain)(grads)
                 loss = jax.lax.psum(loss_sum, "pp") / n_mu
                 return loss, grads
 
@@ -1529,12 +1538,15 @@ class PipelineLMEngine:
                         params, tokens, targets, key,
                         grad_reduce=self._reduce_scatter_dp)
                 else:
+                    gpipe_loss = (local_loss_virtual if vpp > 1
+                                  else local_loss)
                     (loss, _), raw = jax.value_and_grad(
-                        local_loss, has_aux=True)(
+                        gpipe_loss, has_aux=True)(
                             _pvary(params, vary_axes), tokens, targets,
                             key)
                     grads = self._reduce_scatter_dp(raw)
-                    loss = jax.lax.psum(loss, "pp")
+                    loss = jax.lax.psum(
+                        loss, ("pp", "sp") if self.has_sp else "pp")
                 loss = jax.lax.pmean(loss, "dp")
                 grads = tree_map(lambda g: g / self.dp, grads)
                 return loss, grads
@@ -1567,7 +1579,8 @@ class PipelineLMEngine:
                 if fsdp:
                     params = _gather_params(params)
                 loss, _ = loss_fn(params, tokens, targets, train=False)
-                loss = jax.lax.psum(loss, "pp")
+                loss = jax.lax.psum(
+                    loss, ("pp", "sp") if self.has_sp else "pp")
                 return jax.lax.pmean(loss, "dp")
 
             _eval = _eval_z

@@ -97,8 +97,8 @@ def make_attn_core(attn: str, window: int):
         from shallowspeed_tpu.ops import flash_attention as fa
 
         def fwd_save(q, k, v):
-            b, tq, h, d, kvh, g, bq, bk, nqb = fa._geometry(q, k, 512,
-                                                            512)
+            b, tq, h, d, kvh, g, bq, bk, nqb = fa._geometry(
+                q, k, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
             interpret = fa._interpret_default()
             q3 = fa._fold_q(q, kvh)
             k3, v3 = fa._to_bhsd(k), fa._to_bhsd(v)
@@ -110,8 +110,8 @@ def make_attn_core(attn: str, window: int):
             return fa._unfold_q(o3, b, h), {"lse": lse[..., :1]}
 
         def bwd(q, k, v, o, res, do):
-            b, tq, h, d, kvh, g, bq, bk, nqb = fa._geometry(q, k, 512,
-                                                            512)
+            b, tq, h, d, kvh, g, bq, bk, nqb = fa._geometry(
+                q, k, fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K)
             interpret = fa._interpret_default()
             q3 = fa._fold_q(q, kvh)
             k3, v3 = fa._to_bhsd(k), fa._to_bhsd(v)

@@ -24,11 +24,11 @@ def test_pp_ep_composes_and_guards_zero_dp():
     for z in ("--zero1", "--zero2", "--fsdp"):
         expect_exit(["--pp", "2", z],  # dp=1 has nothing to shard
                     "shards over\\s+dp")
-    for z in ("--zero2", "--fsdp"):  # ('dp','pp'[,'tp']) only (round 4)
-        expect_exit(["--dp", "2", "--pp", "2", z, "--sp", "2",
-                     "--attn", "ring"], "no --sp/--ep")
+    for z in ("--zero2", "--fsdp"):
+        # round 5: --sp now composes with --pp + zero2/fsdp; only the
+        # ep exclusion remains (expert grads are ep-sharded)
         expect_exit(["--dp", "2", "--pp", "2", z, "--ep", "2",
-                     "--experts", "2"], "no --sp/--ep")
+                     "--experts", "2"], "no --ep")
 
 
 def test_pp_sp_guards():

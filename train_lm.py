@@ -365,10 +365,11 @@ def train(args) -> float:
             and args.dp < 2:
         raise SystemExit("--pp with --zero1/--zero2/--fsdp shards over "
                          "dp; need --dp >= 2")
-    if args.pp > 1 and (args.zero2 or args.fsdp) \
-            and (args.sp > 1 or args.ep > 1):
+    if args.pp > 1 and (args.zero2 or args.fsdp) and args.ep > 1:
         raise SystemExit("--pp with --zero2/--fsdp takes a "
-                         "('dp','pp'[,'tp']) mesh (no --sp/--ep)")
+                         "('dp','pp'[,'tp'|'sp']) mesh (no --ep: "
+                         "expert-leaf grads are ep-sharded, outside "
+                         "the per-leaf ZeRO scatter rule)")
     if args.pp > 1 and sum(a > 1 for a in (args.tp, args.sp,
                                            args.ep)) > 1:
         raise SystemExit("--pp takes ONE extra model axis: --tp, --sp, "
@@ -444,8 +445,10 @@ def train(args) -> float:
         raise SystemExit("--accum composes with --dp/--sp (the context "
                          "engine) for now; the pipeline engine already "
                          "microbatches via --n-mubatches")
-    if args.fsdp and (args.sp > 1 or args.tp > 1):
-        composite = True  # ZeRO-3 on top of the 3-D mesh
+    if args.fsdp and (args.sp > 1 or args.tp > 1) and args.pp <= 1:
+        # ZeRO-3 on top of the 3-D mesh; with --pp the pipeline engine
+        # owns fsdp x sp (round 5) so this must not reroute it
+        composite = True
     if (args.fsdp or args.tp > 1) and args.pp <= 1 and args.attn != "ring":
         raise SystemExit(f"--attn {args.attn} is not available with "
                          "--tp/--fsdp (the GSPMD engines use XLA attention; "
