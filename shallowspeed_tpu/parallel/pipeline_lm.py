@@ -1669,16 +1669,22 @@ class PipelineLMEngine:
         pipelined model onto one device's memory, defeating the point of
         pipelining it). One shard_map program:
 
-        - **Pipelined prefill**: pp phases; in phase s stage s runs the
-          whole prompt through its block stack (capturing K/V into its
-          LOCAL stage cache) and the activations hop right — the
-          forward-only analogue of the training tick scan.
+        - **Pipelined prefill**: pp*vpp phases; in phase ph, device
+          ph%pp runs chunk ph//pp (logical stage ph) over the whole
+          prompt (capturing K/V into that chunk's rows of its LOCAL
+          cache) and the activations hop right. Interleaved layouts
+          (vpp > 1, round 5) need no special routing: logical stage
+          l = v*pp + d puts consecutive stages on consecutive devices,
+          so the single-hop-per-phase chain visits chunks in logical
+          order automatically — the ring wrap from device pp-1 to 0 IS
+          the chunk boundary.
         - **Decode loop** (`lax.scan` over max_new-1): each token makes
-          the same pp-phase trip; the last stage's hidden state lands
-          back on stage 0 (the ring hop), which holds the replicated
-          head, samples, and `psum`-broadcasts the token to all stages
-          for the next step's embedding. Per-token cost is the inherent
-          pp-stage latency chain; each hop moves only (B, 1, d).
+          the same pp*vpp-phase trip; the last logical stage's hidden
+          state lands back on stage 0 (the ring hop), which holds the
+          replicated head, samples, and `psum`-broadcasts the token to
+          all stages for the next step's embedding. Per-token cost is
+          the inherent logical-stage latency chain; each hop moves only
+          (B, 1, d).
 
         Stage compute sits behind `lax.cond` (the bubble phases cost
         nothing) — safe here, unlike the sp training path, because
@@ -1696,18 +1702,15 @@ class PipelineLMEngine:
             "size 1; ep decode would need the all-to-all inside "
             "cond-gated phases — restore into an ep=1 pipeline to "
             "sample)")
-        assert self.vpp == 1, (
-            "pipelined decode needs plain stage layout (virtual_pp == 1): "
-            "with vpp > 1 the stacked blocks are interleave-permuted and "
-            "the single-hop-per-device phase chain would execute chunks "
-            "in device order, not logical-stage order — restore the "
-            "checkpoint into a vpp=1 pipeline to sample")
         assert not self.fsdp, (
             "pipelined decode needs stage-resident params; restore the "
             "checkpoint into a non-fsdp pipeline to sample")
         attn = partial(attention, causal=True, window=cfg.attn_window)
         dt = cfg.compute_dtype or cfg.dtype
         l_local = self.l_local
+        vpp = self.vpp
+        depth = pp * vpp
+        lcv = l_local // vpp  # layers per chunk (== l_local at vpp=1)
 
         def embed_prompt(params_c, tok):
             x = params_c["tok_emb"][tok]
@@ -1748,9 +1751,15 @@ class PipelineLMEngine:
             cache = _pvary({"k": jnp.zeros(cshape, dt),
                             "v": jnp.zeros(cshape, dt)}, ("pp", "dp"))
 
-            # ---------------- pipelined prefill (pp phases)
-            def pre_work(h, cache):
-                x = jnp.where(s == 0, embed_prompt(params_c, prompt), h)
+            # ------------- pipelined prefill (pp*vpp logical phases)
+            def chunk_blocks(v):
+                return tree_map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, v * lcv, lcv), params_c["blocks"])
+
+            def pre_work(h, cache, v):
+                x = jnp.where((s == 0) & (v == 0),
+                              embed_prompt(params_c, prompt), h)
 
                 def body(x, blk):
                     x, _aux, kv = T._block(blk, x, cfg, attn,
@@ -1758,26 +1767,29 @@ class PipelineLMEngine:
                                            pos=jnp.arange(tp_len))
                     return x, kv
 
-                x, (ks, vs) = jax.lax.scan(body, x, params_c["blocks"])
+                x, (ks, vs) = jax.lax.scan(body, x, chunk_blocks(v))
                 cache = {
-                    "k": jax.lax.dynamic_update_slice_in_dim(
-                        cache["k"], ks.astype(dt), 0, axis=2),
-                    "v": jax.lax.dynamic_update_slice_in_dim(
-                        cache["v"], vs.astype(dt), 0, axis=2),
+                    "k": jax.lax.dynamic_update_slice(
+                        cache["k"], ks.astype(dt),
+                        (v * lcv, 0, 0, 0, 0)),
+                    "v": jax.lax.dynamic_update_slice(
+                        cache["v"], vs.astype(dt),
+                        (v * lcv, 0, 0, 0, 0)),
                 }
                 return x, cache
 
             def phase(carry, ph):
                 h, cache = carry
                 h, cache = jax.lax.cond(
-                    ph == s, pre_work, lambda h, c: (h, c), h, cache)
+                    ph % pp == s, pre_work,
+                    lambda h, c, v: (h, c), h, cache, ph // pp)
                 return (jax.lax.ppermute(h, "pp", s_right), cache), None
 
             h0 = _pvary(jnp.zeros((b, tp_len, cfg.d_model), dt),
                         ("pp", "dp"))
             (h, cache), _ = jax.lax.scan(phase, (h0, cache),
-                                         jnp.arange(pp))
-            # after pp hops the final stage's output sits on stage 0
+                                         jnp.arange(depth))
+            # after depth hops the final stage's output sits on stage 0
             logits = head(params_c, jax.lax.dynamic_index_in_dim(
                 h, tp_actual - 1, 1, False))
             # fold the dp coordinate in (dp>1 only — statically gated so
@@ -1797,35 +1809,43 @@ class PipelineLMEngine:
                            temperature, top_k, top_p)
             tok0 = jax.lax.psum(jnp.where(s == 0, tok0, 0), "pp")
 
-            # ---------------- decode loop (each token: pp phases)
+            # ------- decode loop (each token: pp*vpp logical phases)
             def dstep(carry, i):
                 tok_prev, cache = carry
                 pos = tp_actual + i
 
-                def work(h, cache):
-                    x = jnp.where(s == 0,
+                def work(h, cache, v):
+                    x = jnp.where((s == 0) & (v == 0),
                                   embed_tok(params_c, tok_prev, pos), h)
+                    cache_v = tree_map(
+                        lambda a: jax.lax.dynamic_slice_in_dim(
+                            a, v * lcv, lcv), cache)
 
                     def body(x, xs):
                         blk, cblk = xs
                         x, cblk = _block_decode(blk, x, cfg, cblk, pos)
                         return x, cblk
 
-                    x, cache = jax.lax.scan(
-                        body, x, (params_c["blocks"], cache))
+                    x, cache_v = jax.lax.scan(
+                        body, x, (chunk_blocks(v), cache_v))
+                    cache = tree_map(
+                        lambda a, upd: jax.lax.dynamic_update_slice(
+                            a, upd, (v * lcv,) + (0,) * (a.ndim - 1)),
+                        cache, cache_v)
                     return x, cache
 
                 def phase(carry2, ph):
                     h, cache = carry2
                     h, cache = jax.lax.cond(
-                        ph == s, work, lambda h, c: (h, c), h, cache)
+                        ph % pp == s, work,
+                        lambda h, c, v: (h, c), h, cache, ph // pp)
                     return (jax.lax.ppermute(h, "pp", s_right),
                             cache), None
 
                 h0 = _pvary(jnp.zeros((b, 1, cfg.d_model), dt),
                             ("pp", "dp"))
                 (h, cache), _ = jax.lax.scan(phase, (h0, cache),
-                                             jnp.arange(pp))
+                                             jnp.arange(depth))
                 logits = head(params_c, h[:, 0])
                 tok = _sample(logits, jax.random.fold_in(rng0, i + 1),
                               temperature, top_k, top_p)

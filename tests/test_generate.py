@@ -239,7 +239,7 @@ def test_pipeline_generate_token_exact():
                    {"temperature": 1.0, "top_k": 8, "seed": 7}):
         ref = np.asarray(generate(params, prompt, cfg, 10, **kwargs))
         out = eng.generate(prompt, 10, **kwargs)
-        np.testing.assert_array_equal(out, ref), kwargs
+        np.testing.assert_array_equal(out, ref, err_msg=str(kwargs))
 
 
 def test_pipeline_generate_dp_rows():
@@ -287,10 +287,12 @@ def test_pipeline_generate_dp_sampled_decorrelated():
     np.testing.assert_array_equal(g[0], g[1])
 
 
-def test_pipeline_generate_vpp_guard():
-    """virtual_pp > 1 interleave-permutes the stacked blocks; the
-    single-hop-per-device decode phase chain would run them in the
-    wrong order — _build_generate must refuse (ADVICE r3, medium)."""
+def test_pipeline_generate_vpp_token_exact():
+    """virtual_pp > 1 decode ON the interleave-permuted pp-sharded
+    params (round 5 — the round-4 guard replaced): the pp*vpp-phase
+    chain visits chunks in LOGICAL order (stage l = v*pp + d puts
+    consecutive stages one hop right), so the stream must equal the
+    replicated decode token-for-token — greedy and sampled."""
     import jax as _jax
     from jax.sharding import Mesh as _Mesh
 
@@ -298,19 +300,41 @@ def test_pipeline_generate_vpp_guard():
     from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
 
     cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=4,
-                              n_layers=4, max_seq=32)
+                              n_layers=8, max_seq=48, rope=True,
+                              norm="rmsnorm", ffn="swiglu")
     eng = PipelineLMEngine(
         cfg, SGD(0.1),
         _Mesh(np.array(_jax.devices()[:2]).reshape(1, 2), ("dp", "pp")),
         n_mubatches=1, seed=3, virtual_pp=2)
-    with pytest.raises(AssertionError, match="virtual_pp"):
-        eng.generate(toks(1, b=1, t=8), 4, temperature=0.0)
-    # the canonical-params fallback (what train_lm routes to) still
-    # decodes the same model fine
-    out = np.asarray(generate(eng.get_canonical_params(),
-                              toks(1, b=1, t=8), cfg, 4,
-                              temperature=0.0))
-    assert out.shape == (1, 4)
+    params = eng.get_canonical_params()
+    prompt = toks(5, b=2, t=12, vocab=64)
+    for kwargs in ({"temperature": 0.0},
+                   {"temperature": 1.0, "top_k": 8, "seed": 7}):
+        ref = np.asarray(generate(params, prompt, cfg, 10, **kwargs))
+        out = eng.generate(prompt, 10, **kwargs)
+        np.testing.assert_array_equal(out, ref, err_msg=str(kwargs))
+
+
+def test_pipeline_generate_vpp_dp_greedy():
+    """vpp x dp decode: rows shard over dp, chunks over pp*vpp phases;
+    greedy equals the replicated stream row-for-row."""
+    import jax as _jax
+    from jax.sharding import Mesh as _Mesh
+
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.pipeline_lm import PipelineLMEngine
+
+    cfg = T.TransformerConfig(vocab=32, d_model=32, n_heads=4,
+                              n_layers=8, max_seq=32)
+    eng = PipelineLMEngine(
+        cfg, SGD(0.1),
+        _Mesh(np.array(_jax.devices()[:4]).reshape(2, 2), ("dp", "pp")),
+        n_mubatches=1, seed=3, virtual_pp=2)
+    params = eng.get_canonical_params()
+    prompt = toks(9, b=4, t=8, vocab=32)
+    ref = np.asarray(generate(params, prompt, cfg, 8, temperature=0.0))
+    out = eng.generate(prompt, 8, temperature=0.0)
+    np.testing.assert_array_equal(out, ref)
 
 
 # --------------------------- prompt bucketing / cache sizing (round 4)
@@ -368,7 +392,8 @@ def test_bucketed_stream_matches_exact_length():
             params, jax.numpy.asarray(prompt), _jnp.int32(tp), cfg, 8,
             kwargs.get("temperature", 0.0), kwargs.get("top_k", 0),
             0.0, kwargs.get("seed", 0), cache_len=tp + 8))
-        np.testing.assert_array_equal(out_pub, out_raw), kwargs
+        np.testing.assert_array_equal(out_pub, out_raw,
+                                      err_msg=str(kwargs))
 
 
 def test_kv_cache_sized_to_generation():

@@ -889,8 +889,9 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
     if hasattr(engine, "generate") and getattr(engine, "tp", 1) == 1 \
             and getattr(engine, "sp", 1) == 1 \
             and getattr(engine, "ep", 1) == 1 \
-            and getattr(engine, "vpp", 1) == 1 \
             and not getattr(engine, "fsdp", False):
+        # vpp >= 1 both route here (round 5): the pipelined decode
+        # walks pp*vpp logical phases, chunks in logical order
         # pipeline engine: decode ON the pp-sharded params (no re-gather
         # onto one device's memory); token-stream-identical to the
         # replicated path
