@@ -1,0 +1,76 @@
+"""Tokenize a text corpus into a memmappable shard directory.
+
+The offline half of the streaming data pipeline
+(`shallowspeed_tpu/data/token_shards.py`): runs the tokenizer ONCE,
+writes `shard_*.bin` + `index.json` (+ `val.bin` held-out tail,
++ `tokenizer.json` in BPE mode), and from then on training streams
+windows off disk instead of re-reading/re-encoding `--text` whole into
+RAM every run.
+
+Usage:
+    python scripts/build_token_shards.py --text corpus.txt --out shards/
+        [--tokenizer bpe --vocab-size 8192] [--val-fraction 0.1]
+        [--shard-mb 32]
+Prints one JSON line describing what was written.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--tokenizer", choices=["bytes", "bpe"],
+                    default="bytes")
+    ap.add_argument("--vocab-size", type=int, default=8192,
+                    help="BPE target vocab (ignored for bytes)")
+    ap.add_argument("--val-fraction", type=float, default=0.1)
+    ap.add_argument("--shard-mb", type=int, default=32,
+                    help="approximate shard size in MB of token ids")
+    args = ap.parse_args()
+
+    from shallowspeed_tpu.data.token_shards import build_shards
+
+    raw = open(args.text, "rb").read()
+    if args.tokenizer == "bpe":
+        from shallowspeed_tpu.data.tokenizer import train_bpe
+
+        # train the merges on the TRAIN portion only (the val tail must
+        # not influence the vocabulary)
+        n_val = int(len(raw) * args.val_fraction)
+        tok = train_bpe(raw[:len(raw) - n_val or None], args.vocab_size)
+        ids = tok.encode(raw)
+        vocab = tok.vocab_size
+        Path(args.out).mkdir(parents=True, exist_ok=True)
+        tok.save(Path(args.out) / "tokenizer.json")
+    else:
+        ids = np.frombuffer(raw, np.uint8).astype(np.int32)
+        vocab = 256
+    itemsize = 2 if vocab <= (1 << 16) else 4
+    shard_tokens = max(args.shard_mb * (1 << 20) // itemsize, 1024)
+    out = build_shards(np.asarray(ids), args.out, vocab,
+                       shard_tokens=shard_tokens,
+                       val_fraction=args.val_fraction,
+                       meta={"source": args.text,
+                             "tokenizer": args.tokenizer})
+    idx = json.loads((out / "index.json").read_text())
+    print(json.dumps({
+        "out": str(out), "vocab": vocab,
+        "shards": len(idx["shard_tokens"]),
+        "train_tokens": int(sum(idx["shard_tokens"])),
+        "val_tokens": idx["val_tokens"],
+        "tokenizer": args.tokenizer}))
+
+
+if __name__ == "__main__":
+    main()

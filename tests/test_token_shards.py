@@ -1,0 +1,182 @@
+"""Streaming tokenized-shard corpus (`data/token_shards.py`).
+
+The L0 contracts: round-trip fidelity, pure-in-(seed, step) batches
+(the checkpoint-resume exact-replay property), full per-epoch coverage
+under the affine-permutation order, train/val disjointness, and the
+driver integration (--data-dir streams what --text loaded whole).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.data.token_shards import (TokenShards, ValSplit,
+                                                build_shards)
+
+
+def corpus(n=10_000, vocab=256, seed=0):
+    return np.random.default_rng(seed).integers(0, vocab, n)
+
+
+def test_build_load_roundtrip(tmp_path):
+    toks = corpus(5_000)
+    build_shards(toks, tmp_path, vocab=256, shard_tokens=1_024)
+    ds = TokenShards(tmp_path, seq_len=64)
+    assert ds.vocab == 256 and not ds.has_val
+    # every stored token equals the source at its shard offset
+    idx = json.loads((tmp_path / "index.json").read_text())
+    assert sum(idx["shard_tokens"]) == 5_000
+    assert len(idx["shard_tokens"]) == 5  # ceil(5000/1024) with tail
+    w = ds._window(0)
+    np.testing.assert_array_equal(w, toks[:65])
+
+
+def test_batch_pure_in_seed_and_step(tmp_path):
+    """The exact-replay property: a fresh process (new TokenShards
+    instance) replays the identical stream from any step — resume
+    mid-epoch needs no state beyond the step counter."""
+    build_shards(corpus(), tmp_path, vocab=256, shard_tokens=2_048)
+    a = TokenShards(tmp_path, seq_len=32)
+    run1 = [a.batch(s, 4, seed=7) for s in range(10)]
+    b = TokenShards(tmp_path, seq_len=32)  # "restarted process"
+    for s in range(5, 10):
+        t1, g1 = run1[s]
+        t2, g2 = b.batch(s, 4, seed=7)
+        np.testing.assert_array_equal(t1, t2)
+        np.testing.assert_array_equal(g1, g2)
+    # different seed, different stream
+    assert not np.array_equal(run1[0][0], b.batch(0, 4, seed=8)[0])
+
+
+def test_targets_shift_by_one(tmp_path):
+    build_shards(corpus(), tmp_path, vocab=256)
+    ds = TokenShards(tmp_path, seq_len=16)
+    tok, tgt = ds.batch(3, 4, seed=1)
+    np.testing.assert_array_equal(tok[:, 1:], tgt[:, :-1])
+
+
+@pytest.mark.parametrize("n_tokens,shard_tokens", [(4_000, 1_000),
+                                                   (3_301, 700)])
+def test_perm_order_covers_every_window_once(tmp_path, n_tokens,
+                                             shard_tokens):
+    """One epoch of the affine-permutation order touches every window
+    exactly once (coverage the i.i.d. sampler can't promise), including
+    non-divisible shard tails."""
+    toks = corpus(n_tokens)
+    build_shards(toks, tmp_path, vocab=256, shard_tokens=shard_tokens)
+    ds = TokenShards(tmp_path, seq_len=32)
+    n = ds.n_windows
+    seen = set()
+    bsz = 2
+    for step in range((n + bsz - 1) // bsz):
+        tok, _ = ds.batch(step, bsz, seed=3)
+        for row in tok:
+            seen.add(row.tobytes())
+    assert len(seen) >= n - (bsz - 1)  # epoch 2 may repeat the tail row
+    # second epoch uses a different permutation but the same window set
+    all_windows = {ds._window(w)[:32].tobytes() for w in range(n)}
+    assert seen <= all_windows
+
+
+def test_val_split_disjoint_and_held_out(tmp_path):
+    toks = corpus(8_000)
+    build_shards(toks, tmp_path, vocab=256, shard_tokens=2_048,
+                 val_fraction=0.2)
+    ds = TokenShards(tmp_path, seq_len=32)
+    assert ds.has_val
+    # val IS the corpus tail; train shards hold only the head
+    idx = json.loads((tmp_path / "index.json").read_text())
+    assert idx["val_tokens"] == 1_600
+    assert sum(idx["shard_tokens"]) == 6_400
+    vt, vg = ValSplit(ds).batch(0, 4, seed=2)
+    tail = toks[-1_600:]
+    # every val row appears in the tail stream
+    joined = tail.astype(np.int32).tobytes()
+    for row in vt:
+        assert row.astype(np.int32).tobytes() in joined
+    # determinism
+    vt2, _ = ValSplit(ds).batch(0, 4, seed=2)
+    np.testing.assert_array_equal(vt, vt2)
+
+
+def test_large_vocab_uses_uint32(tmp_path):
+    toks = np.array([0, 1, 70_000, 2, 3] * 100)
+    build_shards(toks, tmp_path, vocab=100_000)
+    idx = json.loads((tmp_path / "index.json").read_text())
+    assert idx["dtype"] == "uint32"
+    ds = TokenShards(tmp_path, seq_len=4)
+    tok, _ = ds.batch(0, 2, seed=0)
+    assert tok.dtype == np.int32
+
+
+def test_no_full_window_rejected(tmp_path):
+    build_shards(corpus(100), tmp_path, vocab=256)
+    with pytest.raises(AssertionError, match="window"):
+        TokenShards(tmp_path, seq_len=256)
+
+
+# ---------------------------------------------------- driver integration
+
+
+def test_train_lm_streams_from_shards(tmp_path):
+    """--data-dir end-to-end: the driver trains off the shard stream
+    (vocab from the index), validates from val.bin, and a resumed run
+    continues the exact batch stream (same step -> same windows)."""
+    from train_lm import make_batch, parse_args, prepare_text
+
+    rng = np.random.default_rng(0)
+    text = (tmp_path / "c.txt")
+    text.write_bytes(bytes(rng.integers(32, 127, 20_000).tolist()))
+    toks = np.frombuffer(text.read_bytes(), np.uint8).astype(np.int32)
+    build_shards(toks, tmp_path / "shards", vocab=256,
+                 shard_tokens=4_096, val_fraction=0.1)
+
+    args = parse_args(["--data-dir", str(tmp_path / "shards"),
+                       "--seq-len", "32", "--batch-size", "4",
+                       "--val-every", "5", "--steps", "4"])
+    vocab, tok, data, val = prepare_text(args)
+    assert vocab == 256 and val is not None
+    t1, g1 = make_batch(args, vocab, 7, data)
+    t2, g2 = make_batch(args, vocab, 7, data)  # replay
+    np.testing.assert_array_equal(t1, t2)
+    np.testing.assert_array_equal(t1[:, 1:], g1[:, :-1])
+    v1, _ = make_batch(args, vocab, 10**9 + 3, val)
+    v2, _ = make_batch(args, vocab, 10**9 + 3, val)
+    np.testing.assert_array_equal(v1, v2)
+    # train and val windows come from disjoint corpus regions
+    head = toks[:18_000].tobytes()
+    assert t1[0].tobytes() in head
+    assert v1[0].tobytes() in toks[18_000:].tobytes()
+
+
+def test_single_window_corpus_batches(tmp_path):
+    """n_windows == 1 must batch (the trivial permutation), not crash
+    in the permutation draw."""
+    build_shards(corpus(40), tmp_path, vocab=256)
+    ds = TokenShards(tmp_path, seq_len=32)
+    assert ds.n_windows == 1
+    tok, tgt = ds.batch(0, 3, seed=0)
+    assert tok.shape == (3, 32)
+    np.testing.assert_array_equal(tok[0], tok[1])  # only one window
+
+
+def test_driver_rejects_bpe_against_byte_shards(tmp_path):
+    from train_lm import parse_args, prepare_text
+
+    build_shards(corpus(5_000), tmp_path / "s", vocab=256)
+    args = parse_args(["--data-dir", str(tmp_path / "s"),
+                       "--seq-len", "32", "--tokenizer", "bpe"])
+    with pytest.raises(SystemExit, match="tokenizer.json"):
+        prepare_text(args)
+
+
+def test_driver_rejects_undersized_val_split(tmp_path):
+    from train_lm import parse_args, prepare_text
+
+    build_shards(corpus(5_000), tmp_path / "s", vocab=256,
+                 val_fraction=0.004)  # 20 tokens of val
+    args = parse_args(["--data-dir", str(tmp_path / "s"),
+                       "--seq-len", "32", "--val-every", "5"])
+    with pytest.raises(SystemExit, match="val.bin holds"):
+        prepare_text(args)

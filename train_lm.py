@@ -178,6 +178,13 @@ def parse_args(argv=None):
                         "also drops into each --pp stage, incl. --pp "
                         "--tp); with --tp/--fsdp alone the GSPMD engines "
                         "use XLA attention (K/V all-gather under --sp)")
+    p.add_argument("--data-dir", type=str, default="",
+                   help="memmapped token-shard corpus directory "
+                        "(scripts/build_token_shards.py): streams "
+                        "windows off disk — deterministic resumable "
+                        "order, held-out val.bin split, no whole-file "
+                        "RAM load. Replaces --text; vocab/tokenizer "
+                        "come from the shard index")
     p.add_argument("--text", type=str, default="",
                    help="train on this UTF-8 text file (byte-level vocab, "
                         "or subword with --tokenizer bpe)")
@@ -263,6 +270,42 @@ def prepare_text(args):
     tokenizer = None
     text_data = val_data = None
     train_bytes = val_bytes = None
+    if args.data_dir:
+        # streaming shard corpus: vocab + tokenizer come FROM the shard
+        # directory (the builder bound them); --text would shadow it
+        from shallowspeed_tpu.data.token_shards import (TokenShards,
+                                                        ValSplit)
+
+        if args.text:
+            raise SystemExit("--data-dir replaces --text (the shard "
+                             "index already fixes the token stream)")
+        shards = TokenShards(args.data_dir, args.seq_len)
+        tok_path = Path(args.data_dir) / "tokenizer.json"
+        if tok_path.exists():
+            from shallowspeed_tpu.data.tokenizer import ByteBPE
+
+            tokenizer = ByteBPE.load(tok_path)
+            assert tokenizer.vocab_size == shards.vocab, (
+                tokenizer.vocab_size, shards.vocab)
+        elif args.tokenizer == "bpe":
+            # the shard index fixes the token stream; a bpe request
+            # against byte-built shards would silently train a
+            # different vocabulary than asked
+            raise SystemExit(
+                f"--tokenizer bpe but {args.data_dir} has no "
+                f"tokenizer.json (it was built byte-level) — rebuild "
+                f"with build_token_shards.py --tokenizer bpe")
+        if args.val_every and not shards.has_val:
+            raise SystemExit(
+                f"--val-every needs a held-out split but {args.data_dir}"
+                f" has no val.bin — rebuild with --val-fraction")
+        if args.val_every and shards.has_val \
+                and len(shards._val) <= args.seq_len + 1:
+            raise SystemExit(
+                f"val.bin holds {len(shards._val)} tokens — shorter "
+                f"than seq_len+2; rebuild with a larger --val-fraction")
+        val_data = ValSplit(shards) if shards.has_val else None
+        return shards.vocab, tokenizer, shards, val_data
     if args.text:
         raw = open(args.text, "rb").read()
         assert len(raw) > args.seq_len + 1, "text too short for --seq-len"
@@ -317,8 +360,12 @@ def make_batch(args, vocab, step: int, text_data=None):
     """(tokens, targets) (B, T) int32 batch for `step` — random-access
     (seeded per step), so a resumed run continues the exact stream an
     uninterrupted run would have seen."""
-    rng = np.random.default_rng([args.seed, step])
     b, t = args.batch_size, args.seq_len
+    if hasattr(text_data, "batch"):
+        # shard-backed stream (TokenShards train view or ValSplit):
+        # same purity contract, order delegated to the dataset
+        return text_data.batch(step, b, seed=args.seed)
+    rng = np.random.default_rng([args.seed, step])
     if text_data is not None:
         starts = rng.integers(0, len(text_data) - t - 1, b)
         tok = np.stack([text_data[s:s + t] for s in starts])
