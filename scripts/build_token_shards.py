@@ -42,27 +42,40 @@ def main():
     from shallowspeed_tpu.data.token_shards import build_shards
 
     raw = open(args.text, "rb").read()
+    assert 0.0 <= args.val_fraction < 1.0, args.val_fraction
     if args.tokenizer == "bpe":
         from shallowspeed_tpu.data.tokenizer import train_bpe
 
-        # train the merges on the TRAIN portion only (the val tail must
-        # not influence the vocabulary)
-        n_val = int(len(raw) * args.val_fraction)
-        tok = train_bpe(raw[:len(raw) - n_val or None], args.vocab_size)
-        ids = tok.encode(raw)
+        # split the BYTES once, then train the merges and encode each
+        # side separately — the val tail never influences the
+        # vocabulary, and the train/val boundary is exactly the byte
+        # boundary (a token-fraction split after encoding can disagree
+        # with the byte split when the tail compresses differently)
+        n_val_bytes = int(len(raw) * args.val_fraction)
+        head = raw[:len(raw) - n_val_bytes] if n_val_bytes else raw
+        assert len(head) > 0, "val_fraction leaves no training bytes"
+        tok = train_bpe(head, args.vocab_size)
+        ids = tok.encode(head)
+        val_ids = (tok.encode(raw[len(head):]) if n_val_bytes
+                   else None)
         vocab = tok.vocab_size
         Path(args.out).mkdir(parents=True, exist_ok=True)
         tok.save(Path(args.out) / "tokenizer.json")
+        itemsize = 2 if vocab <= (1 << 16) else 4
+        out = build_shards(
+            np.asarray(ids), args.out, vocab,
+            shard_tokens=max(args.shard_mb * (1 << 20) // itemsize,
+                             1024),
+            val=val_ids,
+            meta={"source": args.text, "tokenizer": args.tokenizer})
     else:
         ids = np.frombuffer(raw, np.uint8).astype(np.int32)
         vocab = 256
-    itemsize = 2 if vocab <= (1 << 16) else 4
-    shard_tokens = max(args.shard_mb * (1 << 20) // itemsize, 1024)
-    out = build_shards(np.asarray(ids), args.out, vocab,
-                       shard_tokens=shard_tokens,
-                       val_fraction=args.val_fraction,
-                       meta={"source": args.text,
-                             "tokenizer": args.tokenizer})
+        out = build_shards(
+            np.asarray(ids), args.out, vocab,
+            shard_tokens=max(args.shard_mb * (1 << 20) // 2, 1024),
+            val_fraction=args.val_fraction,
+            meta={"source": args.text, "tokenizer": args.tokenizer})
     idx = json.loads((out / "index.json").read_text())
     print(json.dumps({
         "out": str(out), "vocab": vocab,

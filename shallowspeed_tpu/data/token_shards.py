@@ -33,6 +33,7 @@ dropped, like the reference drops the non-divisible batch tail.
 
 from __future__ import annotations
 
+import functools
 import json
 from pathlib import Path
 
@@ -49,23 +50,35 @@ def _token_dtype(vocab: int):
 def build_shards(tokens: np.ndarray, out_dir, vocab: int,
                  shard_tokens: int = 1 << 24,
                  val_fraction: float = 0.0, meta: dict | None = None,
-                 ) -> Path:
+                 val: np.ndarray | None = None) -> Path:
     """Write `tokens` (1-D int array) as a shard directory. The val
     split (if any) is the corpus TAIL, written to its own file before
-    sharding — train/val windows are disjoint by construction."""
+    sharding — train/val windows are disjoint by construction. Pass
+    `val` explicitly when the caller already split the corpus (e.g. the
+    BPE builder splits BYTES before encoding so the tokenizer never
+    sees held-out text); otherwise `val_fraction` carves the token
+    tail here."""
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     tokens = np.asarray(tokens)
     assert tokens.ndim == 1 and len(tokens) > 0, tokens.shape
     assert int(tokens.max()) < vocab, (tokens.max(), vocab)
     dt = _token_dtype(vocab)
-    n_val = int(len(tokens) * val_fraction)
-    if val_fraction:
-        assert n_val > 0, (
-            f"val_fraction={val_fraction} of {len(tokens)} tokens is "
-            f"empty — corpus too small for a held-out split")
-        tokens, val = tokens[:-n_val], tokens[-n_val:]
+    assert val is None or not val_fraction, (
+        "pass EITHER an explicit val array or val_fraction")
+    if val is not None:
+        val = np.asarray(val)
+        n_val = len(val)
+        assert n_val > 0, "explicit val split is empty"
         val.astype(dt).tofile(out / _VAL)
+    else:
+        n_val = int(len(tokens) * val_fraction)
+        if val_fraction:
+            assert 0 < n_val < len(tokens), (
+                f"val_fraction={val_fraction} of {len(tokens)} tokens "
+                f"leaves no usable split")
+            tokens, tail = tokens[:-n_val], tokens[-n_val:]
+            tail.astype(dt).tofile(out / _VAL)
     counts = []
     for i, start in enumerate(range(0, len(tokens), shard_tokens)):
         chunk = tokens[start:start + shard_tokens]
@@ -114,9 +127,12 @@ class TokenShards:
             self._mms[s][off:off + self.seq_len + 1], np.int32)
 
     @staticmethod
+    @functools.lru_cache(maxsize=64)
     def _perm_params(n: int, seed: int, epoch: int):
         """Affine permutation of range(n): j -> (a*j + c) % n with
-        gcd(a, n) == 1 — a full-cycle reshuffle in O(1) state."""
+        gcd(a, n) == 1 — a full-cycle reshuffle in O(1) state. Cached:
+        every row of a batch (and every batch of an epoch) reuses one
+        (a, c) pair."""
         if n == 1:  # single-window corpus: the only permutation
             return 1, 0
         rng = np.random.default_rng([seed, 0x5eed, epoch])
@@ -169,6 +185,13 @@ class TokenShards:
     @property
     def has_val(self) -> bool:
         return self._val is not None
+
+    @property
+    def val_tokens(self) -> int:
+        """Held-out split length (0 when absent) — public so drivers
+        can fail fast on undersized splits without reaching into the
+        memmap."""
+        return 0 if self._val is None else len(self._val)
 
 
 class ValSplit:

@@ -228,9 +228,12 @@ class PipelineLMEngine:
             "pipeline engine (plain-substrate only; see "
             "TransformerConfig.attn_dropout)")
         assert cfg.n_experts == 0 or not self.has_tp, (
-            "MoE x tp is not supported in the pipeline engine (MoE "
-            "composes with dp/pp/sp here, and with dp/ep in "
-            "parallel/expert.py)")
+            "MoE x tp is not supported in the pipeline engine: the "
+            "Megatron placement has no expert-dimension rule, so tp "
+            "peers would each run the FULL routed FFN on identical "
+            "inputs — a correct program that silently wastes the tp "
+            "axis's FLOPs. Expert scaling is the ep axis's job (MoE "
+            "composes with dp/pp/sp here, dp/ep in parallel/expert.py)")
         self.vpp = virtual_pp
         if virtual_pp > 1:
             # interleaved virtual stages: device d hosts logical stages
@@ -239,10 +242,17 @@ class PipelineLMEngine:
             # verified greedy contention schedule as static per-round
             # tables (verify.interleaved_tables — round 4). Either way
             # chunk bodies must be collective-free:
-            assert not self.has_tp and self.sp == 1 and self.ep == 1, (
-                "virtual_pp needs collective-free chunk bodies "
-                "(no tp psum / sp ring / ep all-to-all inside a "
-                "cond-gated chunk)")
+            # tp composes (round 5): the chunk-gating predicate depends
+            # only on (tick, pp coordinate), so every tp peer takes the
+            # SAME cond branch and the Megatron psums inside stay
+            # schedule-identical — unlike sp/ep, whose ring/all-to-all
+            # members span the gated axis (the measured 1F1B x sp
+            # corruption hazard documented in local_1f1b).
+            assert self.sp == 1 and self.ep == 1, (
+                "virtual_pp needs sp/ep-collective-free chunk bodies "
+                "(an sp ring / ep all-to-all inside a cond-gated chunk "
+                "de-syncs the collective schedule across branches; tp "
+                "composes — its psum peers share the gate predicate)")
             assert cfg.n_layers % (self.pp * virtual_pp) == 0, (
                 f"n_layers={cfg.n_layers} must divide over "
                 f"pp*virtual_pp={self.pp * virtual_pp}")

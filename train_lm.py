@@ -300,9 +300,9 @@ def prepare_text(args):
                 f"--val-every needs a held-out split but {args.data_dir}"
                 f" has no val.bin — rebuild with --val-fraction")
         if args.val_every and shards.has_val \
-                and len(shards._val) <= args.seq_len + 1:
+                and shards.val_tokens <= args.seq_len + 1:
             raise SystemExit(
-                f"val.bin holds {len(shards._val)} tokens — shorter "
+                f"val.bin holds {shards.val_tokens} tokens — shorter "
                 f"than seq_len+2; rebuild with a larger --val-fraction")
         val_data = ValSplit(shards) if shards.has_val else None
         return shards.vocab, tokenizer, shards, val_data
