@@ -26,24 +26,25 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np  # noqa: E402
 
 
-def time_generate(params, prompt, cfg, max_new, reps=3):
+def time_generate(params, prompt, cfg, max_new, reps=3, kv_quant=""):
     import jax
 
     from shallowspeed_tpu.models.generate import generate
 
-    out = generate(params, prompt, cfg, max_new, temperature=0.0)
+    out = generate(params, prompt, cfg, max_new, temperature=0.0,
+                   kv_quant=kv_quant)
     jax.device_get(out)  # compile + drain (excluded)
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         jax.device_get(generate(params, prompt, cfg, max_new,
-                                temperature=0.0))
+                                temperature=0.0, kv_quant=kv_quant))
         best = min(best, time.perf_counter() - t0)
     return best
 
 
 def run_config(batch, prompt_len, max_seq, kv_heads=0, d_model=1024,
-               n_layers=8, n_heads=16):
+               n_layers=8, n_heads=16, kv_quant=""):
     import jax
 
     from shallowspeed_tpu.models import transformer as T
@@ -59,16 +60,17 @@ def run_config(batch, prompt_len, max_seq, kv_heads=0, d_model=1024,
 
     n1 = 32
     n2 = min(256, max_seq - prompt_len)
-    t_pre = time_generate(params, prompt, cfg, 1)
-    t1 = time_generate(params, prompt, cfg, n1)
-    t2 = time_generate(params, prompt, cfg, n2)
+    t_pre = time_generate(params, prompt, cfg, 1, kv_quant=kv_quant)
+    t1 = time_generate(params, prompt, cfg, n1, kv_quant=kv_quant)
+    t2 = time_generate(params, prompt, cfg, n2, kv_quant=kv_quant)
     decode_tps = (n2 - n1) * batch / max(t2 - t1, 1e-9)
     return {
         "metric": "decode_throughput",
         "config": {"batch": batch, "prompt_len": prompt_len,
                    "max_seq": max_seq, "d_model": d_model,
                    "n_layers": n_layers, "n_heads": n_heads,
-                   "kv_heads": kv_heads or n_heads},
+                   "kv_heads": kv_heads or n_heads,
+                   "kv_quant": kv_quant or "bf16"},
         "prefill_tokens_per_sec": round(batch * prompt_len / t_pre, 0),
         "decode_tokens_per_sec": round(decode_tps, 1),
         "decode_ms_per_token": round(1000.0 / (decode_tps / batch), 3),
@@ -129,7 +131,21 @@ def main():
                     help="benchmark pipelined decode over a virtual "
                          "pp-device CPU mesh instead of the single-chip "
                          "KV-cache decode")
+    ap.add_argument("--long-context", action="store_true",
+                    help="the cache-share-dominant regime (b8, ~8k "
+                         "context): bf16 vs int8 KV cache head-to-head "
+                         "(round 5 — the lever the round-4 roofline "
+                         "named for when the cache dominates)")
     args = ap.parse_args()
+    if args.long_context:
+        for kv_quant in ("", "int8"):
+            print(json.dumps(run_config(
+                batch=8, prompt_len=7936, max_seq=8192,
+                kv_quant=kv_quant)), flush=True)
+            print(json.dumps(run_config(
+                batch=8, prompt_len=7936, max_seq=8192, kv_heads=4,
+                kv_quant=kv_quant)), flush=True)
+        return
     if args.pp:
         import os
 
