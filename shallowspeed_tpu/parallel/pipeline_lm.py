@@ -178,10 +178,11 @@ class PipelineLMEngine:
                 "schedule='zb' IS the no-recompute schedule: it stashes "
                 "block residuals F->B by design (remat would undo the "
                 "B=1 cost the schedule needs)")
-            assert not (zero2 or fsdp), (
-                "schedule='zb' composes with plain dp / --zero1 (the "
-                "reduce-scatter substitution is not wired into the "
-                "zb scan)")
+            # zero2/fsdp compose (round 5, same day it shipped): the zb
+            # scan accumulates raw per-device partials and takes the
+            # identical grad_reduce substitution the 1F1B scan does, so
+            # the dp reduce-scatter drops in unchanged (parity tests in
+            # tests/test_pipeline_zb.py)
         assert virtual_pp >= 1, virtual_pp
         assert attn in ("xla", "flash", "ring", "ring-flash",
                         "ulysses-flash"), attn

@@ -466,9 +466,6 @@ def train(args) -> float:
             raise SystemExit("--pp-schedule zb IS the no-recompute "
                              "schedule (it stashes residuals F->B); "
                              "drop --remat")
-        if args.zero2 or args.fsdp:
-            raise SystemExit("--pp-schedule zb composes with plain dp "
-                             "or --zero1 (no --zero2/--fsdp)")
     if args.ep > 1 and args.tp > 1:
         raise SystemExit("--ep composes with --dp/--sp (not --tp)")
     if args.keep_checkpoints < 0:
