@@ -202,6 +202,14 @@ def parse_args(argv=None):
     p.add_argument("--top-p", type=float, default=0.0,
                    help="nucleus sampling: keep the smallest probability "
                         "mass >= p (0 = off; composes with --top-k)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="decode with an int8-quantized KV cache (halves "
+                        "the cache sweep's HBM bytes — measured 1.18x "
+                        "decode on bandwidth-bound GQA long-context, "
+                        "BASELINE.md; streams are deterministic but not "
+                        "bit-equal to the bf16 cache). Replicated decode "
+                        "path only — the pipelined per-stage cache stays "
+                        "bf16")
     p.add_argument("--prompt", type=str, default="",
                    help="UTF-8 prompt for --generate (byte-level; default: "
                         "a 16-token prefix from the data stream)")
@@ -930,7 +938,8 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
     else:
         prompt, _ = make_batch(args, vocab, 0, text_data)
         prompt = prompt[:1, :16]  # one row, short prefix
-    if hasattr(engine, "generate") and getattr(engine, "tp", 1) == 1 \
+    if not args.kv_int8 and hasattr(engine, "generate") \
+            and getattr(engine, "tp", 1) == 1 \
             and getattr(engine, "sp", 1) == 1 \
             and getattr(engine, "ep", 1) == 1 \
             and not getattr(engine, "fsdp", False):
@@ -938,16 +947,27 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
         # walks pp*vpp logical phases, chunks in logical order
         # pipeline engine: decode ON the pp-sharded params (no re-gather
         # onto one device's memory); token-stream-identical to the
-        # replicated path
+        # replicated path. --kv-int8 routes to the replicated path
+        # (the quantized cache lives in models/generate only)
         out = engine.generate(prompt, args.generate,
                               temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p,
                               seed=args.seed)
     else:
+        if args.kv_int8 and hasattr(engine, "generate"):
+            # the quantized cache lives in the replicated decode path
+            # only — say so OUT LOUD, because this re-gathers the full
+            # params onto one device (the memory cost the pipelined
+            # decode exists to avoid)
+            rprint("note: --kv-int8 decodes on the REPLICATED path "
+                   "(full params re-gathered to one device); the "
+                   "pipelined per-stage cache stays bf16 — drop "
+                   "--kv-int8 to decode on the pp-sharded params")
         out = np.asarray(generate(
             engine.get_canonical_params(), prompt, cfg, args.generate,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, seed=args.seed))
+            top_p=args.top_p, seed=args.seed,
+            kv_quant="int8" if args.kv_int8 else ""))
     if tokenizer is not None:
         rprint(f"prompt: {tokenizer.decode_bytes(prompt[0])!r}")
         rprint(f"sample: {tokenizer.decode_bytes(out[0])!r}")
