@@ -4,10 +4,11 @@ The reference's only inference surface is a forward-only pipeline schedule
 over the MLP (`/root/reference/shallowspeed/pipe.py:275-294`); sequence
 models need real decoding. Designed TPU-first:
 
-- **Static shapes.** The KV cache is a fixed (B, max_seq, H, hd) buffer
-  per block; the decode loop is one `lax.scan` over `max_new` steps —
-  the whole generation compiles to a single XLA program, no per-token
-  Python dispatch or retracing.
+- **Static shapes.** The KV cache is a fixed head-major
+  (B, Hkv, cache_len, hd) buffer per block (sized to prompt bucket +
+  max_new, not max_seq); the decode loop is one `lax.scan` over
+  `max_new` steps — the whole generation compiles to a single XLA
+  program, no per-token Python dispatch or retracing.
 - **Parallel prefill.** The prompt runs through the normal batched
   forward (`_block(..., with_kv=True)` captures each block's K/V in one
   MXU-friendly pass); only the new tokens decode sequentially.
@@ -33,9 +34,17 @@ from shallowspeed_tpu.models import transformer as T
 
 def init_kv_cache(cfg: T.TransformerConfig, batch: int,
                   cache_len: int | None = None, kv_quant: str = ""):
-    """Per-block K/V buffers (B, cache_len, Hkv, head_dim), zero-filled —
+    """Per-block K/V buffers (B, Hkv, cache_len, head_dim), zero-filled —
     under GQA the cache holds the UNREPEATED kv heads, shrinking its
     memory by the query-group factor.
+
+    HEAD-MAJOR layout (round 5): the decode sweep reads one head's
+    whole history per (batch, head) — with the old (B, S, Hkv, hd)
+    layout those reads were hd*2 = 128-byte rows at an Hkv*hd*2-byte
+    stride (sub-DMA-granularity: the b8 8k MHA sweep measured 257 GB/s
+    vs the 819 GB/s roofline); head-major makes each (b, h) sweep one
+    contiguous (S, hd) block. The per-token write transposes a
+    (B, 1, Hkv, hd) slice — noise next to the read it fixes.
 
     `cache_len` defaults to cfg.max_seq; `generate` passes the SIZED
     length (prompt bucket + max_new) instead — decode is HBM-bound on
@@ -50,7 +59,7 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
     the score, V's folds into the probability row), so HBM reads stay
     int8 — see `_cached_attention`."""
     dt = cfg.compute_dtype or cfg.dtype
-    shape = (batch, cache_len or cfg.max_seq, cfg.kv_heads, cfg.head_dim)
+    shape = (batch, cfg.kv_heads, cache_len or cfg.max_seq, cfg.head_dim)
     if kv_quant:
         assert kv_quant == "int8", kv_quant
         sshape = shape[:3] + (1,)
@@ -64,8 +73,8 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
 
 
 def _quantize_kv(x):
-    """(values int8, scales f32): symmetric per-(b, t, head) absmax
-    quantization over the head_dim axis (x: (B, T, Hkv, hd))."""
+    """(values int8, scales f32): symmetric per-(b, head, t) absmax
+    quantization over the head_dim axis (x: (B, Hkv, T, hd))."""
     xf = x.astype(jnp.float32)
     scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
     scale = jnp.maximum(scale, 1e-8)
@@ -74,8 +83,12 @@ def _quantize_kv(x):
 
 
 def _cache_write(cache_blk, k, v, pos):
-    """Write this slice's K/V at `pos`, quantizing when the cache is
-    int8 (presence of the scale leaves is the dispatch)."""
+    """Write this slice's K/V at `pos` (k/v arrive token-major
+    (B, T, Hkv, hd) from the block; the cache is head-major),
+    quantizing when the cache is int8 (the scale leaves' presence is
+    the dispatch)."""
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
     if "k_s" in cache_blk:
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
@@ -86,7 +99,7 @@ def _cache_write(cache_blk, k, v, pos):
     return {
         **cache_blk,
         **{name: jax.lax.dynamic_update_slice_in_dim(
-            cache_blk[name], val, pos, axis=1)
+            cache_blk[name], val, pos, axis=2)
            for name, val in upd.items()},
     }
 
@@ -100,44 +113,43 @@ def _cached_attention(q, cache_blk, pos, cfg):
     cache sweep, so the group factor shrinks the per-step traffic, not
     just the cache footprint.
     """
-    k, v = cache_blk["k"], cache_blk["v"]
+    k, v = cache_blk["k"], cache_blk["v"]       # (B, Hkv, S, hd)
     b, _, h, hd = q.shape
-    kvh = k.shape[2]
+    kvh = k.shape[1]
+    slots = k.shape[2]
     quant = "k_s" in cache_blk
     qg = q.reshape(b, 1, kvh, h // kvh, hd)
     scale = 1.0 / jnp.sqrt(jnp.float32(hd))
     if quant:
         # int8 sweep: the einsum reads int8 rows (the cast fuses into
         # the load; int8 values are EXACT in bf16, so the MXU runs at
-        # its bf16 rate with f32 accumulation); K's per-(b, t, head)
+        # its bf16 rate with f32 accumulation); K's per-(b, head, t)
         # scale is constant over hd, so it multiplies the SCORE
         # instead of dequantizing the cache
         cdt = cfg.compute_dtype or cfg.dtype
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(cdt),
+        s = jnp.einsum("bqhgd,bhkd->bhgqk", qg.astype(cdt),
                        k.astype(cdt),
                        preferred_element_type=jnp.float32)
-        s = s * jnp.transpose(cache_blk["k_s"],
-                              (0, 2, 3, 1))[:, :, None, :, :]
+        s = s * cache_blk["k_s"][..., 0][:, :, None, None, :]
         s = s * scale
     else:
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+        s = jnp.einsum("bqhgd,bhkd->bhgqk", qg, k,
                        preferred_element_type=jnp.float32) * scale
-    valid = jnp.arange(k.shape[1]) <= pos                  # (max_seq,)
+    valid = jnp.arange(slots) <= pos                       # (S,)
     if cfg.attn_window > 0:  # same window the training mask applies
-        valid = valid & (jnp.arange(k.shape[1]) > pos - cfg.attn_window)
+        valid = valid & (jnp.arange(slots) > pos - cfg.attn_window)
     s = jnp.where(valid[None, None, None, None, :], s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
     if quant:
         # V's scale varies along the summation index — fold it into the
         # (tiny) probability rows, keeping the V read int8
         cdt = cfg.compute_dtype or cfg.dtype
-        pv = (p * jnp.transpose(cache_blk["v_s"],
-                                (0, 2, 3, 1))[:, :, None, :, :])
-        out = jnp.einsum("bhgqk,bkhd->bqhgd", pv.astype(cdt),
+        pv = p * cache_blk["v_s"][..., 0][:, :, None, None, :]
+        out = jnp.einsum("bhgqk,bhkd->bqhgd", pv.astype(cdt),
                          v.astype(cdt),
                          preferred_element_type=jnp.float32)
     else:
-        out = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v,
+        out = jnp.einsum("bhgqk,bhkd->bqhgd", p.astype(v.dtype), v,
                          preferred_element_type=jnp.float32)
     return out.reshape(b, 1, h, hd).astype(q.dtype)
 

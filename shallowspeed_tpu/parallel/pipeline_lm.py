@@ -1753,8 +1753,10 @@ class PipelineLMEngine:
             # cache sized to the generation (bucket + max_new), not
             # max_seq; `tp_actual` is the traced true prompt length —
             # pad-slot K/V is overwritten before the position mask can
-            # admit it (same argument as models.generate)
-            cshape = (l_local, b, tp_len + max_new, cfg.kv_heads,
+            # admit it (same argument as models.generate). Head-major
+            # slot layout (round 5), matching init_kv_cache: each
+            # (b, head) decode sweep reads one contiguous (S, hd) block
+            cshape = (l_local, b, cfg.kv_heads, tp_len + max_new,
                       cfg.head_dim)
             # zeros are axis-invariant; the filled cache / hopped
             # activations vary over (pp, dp) — pvary so lax.cond
@@ -1779,12 +1781,14 @@ class PipelineLMEngine:
                     return x, kv
 
                 x, (ks, vs) = jax.lax.scan(body, x, chunk_blocks(v))
+                # captured K/V arrive token-major (lcv, b, T, kvh, hd);
+                # the cache is head-major — transpose once per prefill
                 cache = {
                     "k": jax.lax.dynamic_update_slice(
-                        cache["k"], ks.astype(dt),
+                        cache["k"], jnp.swapaxes(ks, 2, 3).astype(dt),
                         (v * lcv, 0, 0, 0, 0)),
                     "v": jax.lax.dynamic_update_slice(
-                        cache["v"], vs.astype(dt),
+                        cache["v"], jnp.swapaxes(vs, 2, 3).astype(dt),
                         (v * lcv, 0, 0, 0, 0)),
                 }
                 return x, cache

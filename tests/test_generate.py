@@ -404,7 +404,7 @@ def test_kv_cache_sized_to_generation():
 
     cfg = replace(CFG, max_seq=4096)
     cache = init_kv_cache(cfg, 2, cache_len=96)
-    assert cache[0]["k"].shape[1] == 96
+    assert cache[0]["k"].shape[2] == 96  # head-major: slots on axis 2
     assert prompt_bucket_len(5, 32, 4096) == 64
     assert prompt_bucket_len(65, 32, 4096) == 128
     assert prompt_bucket_len(5, 4090, 4096) == 6   # capped by max_seq
@@ -449,7 +449,7 @@ def test_int8_kv_cache_layout_and_memory():
     cache = init_kv_cache(cfg, 2, cache_len=16, kv_quant="int8")
     blk = cache[0]
     assert blk["k"].dtype == jnp.int8 and blk["v"].dtype == jnp.int8
-    assert blk["k_s"].shape == (2, 16, cfg.n_heads, 1)
+    assert blk["k_s"].shape == (2, cfg.n_heads, 16, 1)
     q_bytes = sum(np.prod(v.shape) * v.dtype.itemsize
                   for v in blk.values())
     f_bytes = 2 * np.prod((2, 16, cfg.n_heads,

@@ -53,8 +53,9 @@ def test_invalid_group_rejected():
 
 
 def test_cache_stores_unrepeated_heads():
+    # head-major slot layout (round 5): (B, Hkv, S, hd)
     cache = init_kv_cache(CFG, batch=2)
-    assert cache[0]["k"].shape == (2, CFG.max_seq, 2, CFG.head_dim)
+    assert cache[0]["k"].shape == (2, 2, CFG.max_seq, CFG.head_dim)
 
 
 def test_repeat_kv():
