@@ -18,7 +18,7 @@ Public API (lazily imported so `import shallowspeed_tpu` stays cheap):
         SGD, MomentumSGD, Adam, AdamW, Adafactor, ema_update,
         OPTIMIZERS, SCHEDULES,
         ByteBPE, train_bpe, simulate_schedule,
-        checkpoint, distributed, metrics,
+        analysis, checkpoint, distributed, metrics,
     )
 """
 
@@ -59,6 +59,7 @@ _EXPORTS = {
     "Supervisor": "shallowspeed_tpu.elastic",
     "RestartPolicy": "shallowspeed_tpu.elastic",
     # subsystem modules
+    "analysis": "shallowspeed_tpu.analysis",
     "checkpoint": "shallowspeed_tpu.checkpoint",
     "distributed": "shallowspeed_tpu.distributed",
     "elastic": "shallowspeed_tpu.elastic",
@@ -67,8 +68,8 @@ _EXPORTS = {
     "utils": "shallowspeed_tpu.utils",
 }
 
-_MODULE_EXPORTS = {"checkpoint", "distributed", "elastic", "metrics",
-                   "optim", "utils"}
+_MODULE_EXPORTS = {"analysis", "checkpoint", "distributed", "elastic",
+                   "metrics", "optim", "utils"}
 
 __all__ = sorted(_EXPORTS) + ["functional"]
 

@@ -1,0 +1,92 @@
+"""Static TPU-cleanliness analysis of the compiled train steps.
+
+`parallel/verify.py` proves SCHEDULE-level invariants by simulation
+(deadlock-freedom, FIFO channels, stash bounds); this package is the
+same discipline one layer down, at what XLA is actually handed: a jaxpr
+walker over the real train-step closures plus a rule registry that
+statically proves each compiled step is TPU-clean —
+
+- no f32 leaks on declared-bf16 compute paths (``dtype-promotion``),
+- params/opt-state buffers donated by every step (``donation``),
+- every collective's axes bound by its constructing mesh, pipeline
+  ppermutes a single ring cycle (``collective``),
+- one executable per entrypoint for the test suite's shape set
+  (``retrace``),
+- static live-buffer high-water inside the HBM budget
+  (``memory-highwater``).
+
+Intentional deviations are suppressed INLINE at the code that causes
+them (`findings.suppress`, mandatory reason string), so the analyzer's
+report doubles as documentation of every deliberate exception.
+
+Usage:
+    python -m shallowspeed_tpu.analysis --target all        # CLI gate
+    from shallowspeed_tpu import analysis
+    findings = analysis.analyze("pipeline_lm:1f1b")
+
+The tier-1 test `tests/test_analysis.py` pins the shipped train steps
+to ZERO unsuppressed high-severity findings.
+"""
+
+from __future__ import annotations
+
+# findings is deliberately stdlib-only and imported EAGERLY: engine/ops
+# modules register inline suppressions at import time, and importing a
+# submodule executes this package __init__ first — everything jax-heavy
+# below stays behind the PEP 562 lazy hook so those modules' import
+# cost (and backend-initialization hygiene, see ops/attention.py) is
+# unchanged.
+from shallowspeed_tpu.analysis.findings import (Finding, Severity,  # noqa: F401
+                                                apply_suppressions,
+                                                gate_count, suppress)
+
+_EXPORTS = {
+    "RULES": "shallowspeed_tpu.analysis.rules",
+    "rule": "shallowspeed_tpu.analysis.rules",
+    "run_rules": "shallowspeed_tpu.analysis.rules",
+    "TARGET_BUILDERS": "shallowspeed_tpu.analysis.targets",
+    "TARGET_GROUPS": "shallowspeed_tpu.analysis.targets",
+    "EntryPoint": "shallowspeed_tpu.analysis.targets",
+    "TargetProbe": "shallowspeed_tpu.analysis.targets",
+    "resolve_targets": "shallowspeed_tpu.analysis.targets",
+    "aval_bytes": "shallowspeed_tpu.analysis.walker",
+    "iter_eqns": "shallowspeed_tpu.analysis.walker",
+    "peak_bytes": "shallowspeed_tpu.analysis.walker",
+}
+
+__all__ = sorted((
+    "Finding", "Severity", "suppress", "apply_suppressions",
+    "gate_count", "analyze", *_EXPORTS))
+
+
+def __getattr__(name):  # PEP 562 lazy re-exports (jax-heavy modules)
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return __all__
+
+
+def analyze(target: str = "all", budget: int | None = None,
+            only: tuple = ()) -> dict:
+    """Build and lint `target` (a probe name or group alias). Returns
+    {probe name: [Finding, ...]}; `gate_count` over the concatenation
+    is the CI gate."""
+    from shallowspeed_tpu.analysis.rules import run_rules
+    from shallowspeed_tpu.analysis.targets import (DEFAULT_BUDGET,
+                                                   TARGET_BUILDERS,
+                                                   resolve_targets)
+
+    out = {}
+    for name in resolve_targets(target):
+        probe = TARGET_BUILDERS[name](budget=budget or DEFAULT_BUDGET)
+        out[probe.name] = run_rules(probe, only=only)
+    return out

@@ -1,0 +1,81 @@
+"""CLI gate: `python -m shallowspeed_tpu.analysis --target all`.
+
+Builds each requested target's engines at the test-suite configuration,
+runs every rule, prints the findings (suppressed ones with their
+reasons — the intentional-deviation documentation), and exits non-zero
+iff any unsuppressed HIGH finding remains. Wired as a pre-commit hook
+(`.pre-commit-config.yaml`) and enforced in tier-1 by
+`tests/test_analysis.py`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m shallowspeed_tpu.analysis",
+        description="statically prove the compiled train steps are "
+                    "TPU-clean (dtype / donation / collectives / "
+                    "retrace / memory)")
+    ap.add_argument("--target", default="all",
+                    help="probe or group: engine, spmd_pipeline, gspmd, "
+                         "pipeline_lm, zb, all, or an exact probe name "
+                         "like pipeline_lm:1f1b (default: all)")
+    ap.add_argument("--budget-gb", type=float, default=16.0,
+                    help="HBM budget for the memory-highwater rule "
+                         "(default: 16 GiB — one v4/v5e-class chip)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--platform", default=os.environ.get(
+        "JAX_PLATFORMS", "cpu"),
+        help="jax platform (default: cpu — the pass is static; probes "
+             "run on 8 virtual host devices)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only gating findings and the summary")
+    args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    from shallowspeed_tpu.analysis import (RULES, Severity, analyze,
+                                           gate_count)
+
+    only = tuple(r for r in args.rules.split(",") if r)
+    unknown = [r for r in only if r not in RULES]
+    if unknown:  # a typo must not silently run zero rules and exit 0
+        raise SystemExit(
+            f"unknown rule(s) {unknown}; pick from {sorted(RULES)}")
+    budget = int(args.budget_gb * (1 << 30))
+    results = analyze(args.target, budget=budget, only=only)
+
+    total = []
+    for name, findings in results.items():
+        total.extend(findings)
+        shown = [f for f in findings
+                 if not args.quiet or (f.severity == Severity.HIGH
+                                       and not f.suppressed)]
+        print(f"== {name}: {len(findings)} finding(s), "
+              f"{gate_count(findings)} gating")
+        for f in shown:
+            print("  " + f.format().replace("\n", "\n  "))
+    n_gate = gate_count(total)
+    n_sup = sum(1 for f in total if f.suppressed)
+    print(f"\n{len(results)} target(s), {len(total)} finding(s): "
+          f"{n_gate} gating high-severity, {n_sup} suppressed "
+          f"(documented above)")
+    return 1 if n_gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Structured findings + the suppression registry.
+
+A `Finding` is one fact the static pass proved about a compiled train
+step: which rule fired, how bad it is, which target/entrypoint it lives
+in, and the jaxpr provenance (the chain of enclosing sub-jaxprs — pjit /
+shard_map / scan / cond / remat — down to the offending equation).
+
+Suppressions are the inline escape hatch: a module that does something
+the linter flags ON PURPOSE registers a suppression NEXT TO the code
+that causes it, with a mandatory reason string — so the analyzer doubles
+as documentation of every deliberate deviation. A suppressed finding is
+still reported (with its reason); it just stops counting against the
+zero-high-severity gate.
+
+This module is dependency-free (stdlib only) so engine modules can
+import it at module scope without dragging jax tracing machinery in.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from fnmatch import fnmatch
+
+
+class Severity(enum.IntEnum):
+    """LOW = informational (always emitted, never gates); MEDIUM = smells
+    that deserve a look; HIGH = provable TPU-cleanliness violations — the
+    CI gate fails on any unsuppressed HIGH."""
+
+    LOW = 0
+    MEDIUM = 1
+    HIGH = 2
+
+
+@dataclass
+class Finding:
+    rule: str                    # registry name, e.g. "donation"
+    severity: Severity
+    target: str                  # probe name, e.g. "pipeline_lm:1f1b"
+    site: str                    # entrypoint name, e.g. "_step"
+    path: tuple = ()             # enclosing sub-jaxpr chain (prim names)
+    message: str = ""
+    suppressed: str | None = None  # reason string when suppressed
+
+    @property
+    def where(self) -> str:
+        chain = "/".join(self.path)
+        return f"{self.target}::{self.site}" + (f" [{chain}]" if chain
+                                                else "")
+
+    def format(self) -> str:
+        tag = ("suppressed"
+               if self.suppressed else self.severity.name)
+        out = f"[{tag:>10}] {self.rule:<18} {self.where}: {self.message}"
+        if self.suppressed:
+            out += f"\n{'':>13}reason: {self.suppressed}"
+        return out
+
+
+@dataclass
+class Suppression:
+    rule: str       # rule name or "*"
+    target: str     # fnmatch glob over the probe name
+    match: str      # substring of the finding's message/site ("" = any)
+    reason: str
+
+
+_REGISTRY: list[Suppression] = []
+
+
+def suppress(rule: str, target: str = "*", match: str = "",
+             reason: str = "") -> Suppression:
+    """Register an intentional-deviation suppression. `reason` is
+    mandatory — the analyzer's report prints it, so the registration
+    site IS the documentation of why the finding is deliberate."""
+    assert reason.strip(), (
+        "suppress() requires a non-empty reason string — the suppression "
+        "doubles as documentation of the intentional finding")
+    s = Suppression(rule, target, match, reason)
+    _REGISTRY.append(s)
+    return s
+
+
+def registered_suppressions() -> tuple:
+    return tuple(_REGISTRY)
+
+
+def clear_suppressions(keep=()) -> None:
+    """Testing hook: reset the registry (optionally to a saved snapshot
+    from `registered_suppressions`)."""
+    _REGISTRY.clear()
+    _REGISTRY.extend(keep)
+
+
+def apply_suppressions(findings: list) -> list:
+    """Mark each finding suppressed by the first matching registration.
+    Matching: rule name (or '*'), target glob, and `match` as a
+    substring of `site`, the sub-jaxpr path, or the message."""
+    for f in findings:
+        for s in _REGISTRY:
+            if s.rule not in ("*", f.rule):
+                continue
+            if not fnmatch(f.target, s.target):
+                continue
+            hay = " ".join((f.site, "/".join(f.path), f.message))
+            if s.match and s.match not in hay:
+                continue
+            f.suppressed = s.reason
+            break
+    return findings
+
+
+def gate_count(findings: list) -> int:
+    """Number of findings that fail the CI gate: HIGH and unsuppressed."""
+    return sum(1 for f in findings
+               if f.severity == Severity.HIGH and not f.suppressed)

@@ -1,0 +1,446 @@
+"""The rule registry and the five shipped lint rules.
+
+Each rule is a pure function `(probe) -> list[Finding]` over a
+`TargetProbe` (`targets.py`): the probe holds the real train-step
+entrypoints, their traced jaxprs, the constructing mesh, and the
+declared compute dtype. Rules never execute device code except where
+the check IS behavioral (the retrace audit reads compilation-cache
+sizes after the probe exercised each entrypoint with the test suite's
+shape/dtype set).
+
+Shipped rules:
+
+- ``dtype-promotion``  f32 leaking onto declared-bf16 compute paths:
+  matmuls with mixed bf16/f32 operands (weak-type promotion) or fed by
+  an explicit bf16->f32 upcast, and round-trip convert chains.
+- ``donation``         step-like entrypoints whose params/opt-state
+  buffers are not donated (an extra HBM copy of the model per step).
+- ``collective``       psum/ppermute/all_gather/... axis names checked
+  against the axes bound by the enclosing shard_map's mesh (and that
+  mesh against the probe's); ppermute permutations must be valid and —
+  on the 'pp' pipeline axis — a single cycle, the shape every schedule
+  here is built on.
+- ``retrace``          >1 compilation per entrypoint after the probe
+  ran the test-suite shape/dtype set through it (retrace storms).
+- ``memory-highwater`` static live-buffer byte estimate per entrypoint
+  jaxpr vs the probe's HBM budget.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+
+from shallowspeed_tpu.analysis.findings import (Finding, Severity,
+                                                apply_suppressions)
+from shallowspeed_tpu.analysis.walker import aval_bytes, peak_bytes
+
+RULES: dict[str, Callable] = {}
+
+# collectives whose eqn params name mesh axes, with the param key
+_COLLECTIVES = {
+    "psum": "axes", "pmin": "axes", "pmax": "axes",
+    "ppermute": "axis_name", "pbroadcast": "axis_name",
+    "all_gather": "axis_name", "reduce_scatter": "axis_name",
+    "psum_scatter": "axis_name", "all_to_all": "axis_name",
+    "axis_index": "axis_name", "pgather": "axes",
+}
+
+
+def rule(name: str):
+    def register(fn):
+        RULES[name] = fn
+        fn.rule_name = name
+        return fn
+    return register
+
+
+def run_rules(probe, only: tuple = ()) -> list:
+    """All findings for one probe: identical findings deduplicated with
+    a count (a rule firing on 4 layers x 4 matmuls is ONE fact),
+    suppressions applied, HIGH first."""
+    findings: list[Finding] = []
+    for name, fn in RULES.items():
+        if only and name not in only:
+            continue
+        findings.extend(fn(probe))
+    grouped: dict[tuple, Finding] = {}
+    counts: dict[tuple, int] = {}
+    for f in findings:
+        key = (f.rule, f.severity, f.target, f.site, f.path, f.message)
+        counts[key] = counts.get(key, 0) + 1
+        grouped.setdefault(key, f)
+    deduped = []
+    for key, f in grouped.items():
+        if counts[key] > 1:
+            f.message += f" (x{counts[key]})"
+        deduped.append(f)
+    apply_suppressions(deduped)
+    deduped.sort(key=lambda f: (-int(f.severity), f.rule, f.site))
+    return deduped
+
+
+def _axis_names(axes) -> tuple:
+    """Normalize an eqn's axis param to a tuple of names (drops
+    positional ints, which cannot mismatch a mesh)."""
+    if axes is None:
+        return ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+# ------------------------------------------------------- dtype promotion
+
+
+def _f32_origin(var, made_by, bf16, f32, budget: int = 128) -> str:
+    """Classify where a mixed-matmul's f32 operand comes from, walking
+    its producer chain within the scope:
+
+    - "accum": the chain roots in a dot_general with bf16 input(s) —
+      a deliberate `preferred_element_type=f32` accumulation (or its
+      transpose); f32 here is the documented score-path numerics.
+    - "cast": the chain crosses a bf16->f32 convert — the data WAS
+      bf16; in a backward jaxpr this is the transpose of an intended
+      downcast (cotangents of `.astype(bf16)` arrive f32). Pays f32
+      rate for this matmul but is structurally forced by the primal's
+      cast placement.
+    - "local": the chain resolves fully in-scope with NO bf16 origin
+      anywhere (f32 constants / scalars) — a genuine weak-type
+      promotion: bf16 data was meant to flow here and never did.
+    - "unknown": the chain leaves the scope (scan carries, stashed
+      residuals) or exceeds the walk budget.
+    """
+    seen: set = set()
+    frontier = [var]
+    fully_resolved = True
+    has_accum = has_cast = False
+    while frontier and budget > 0:
+        v = frontier.pop()
+        if id(v) in seen:
+            continue
+        seen.add(id(v))
+        eqn = made_by.get(v)
+        if eqn is None:  # scope input / const — producer invisible
+            fully_resolved = False
+            continue
+        budget -= 1
+        name = eqn.primitive.name
+        if name == "dot_general":
+            in_dts = {np.dtype(iv.aval.dtype) for iv in eqn.invars[:2]
+                      if hasattr(iv.aval, "dtype")}
+            if bf16 in in_dts:
+                has_accum = True
+                continue
+        if (name == "convert_element_type"
+                and getattr(eqn.invars[0].aval, "dtype", None) is not None
+                and np.dtype(eqn.invars[0].aval.dtype) == bf16):
+            has_cast = True
+            continue
+        for iv in eqn.invars:
+            if (not isinstance(iv, jax.core.Literal)
+                    and getattr(iv.aval, "dtype", None) is not None
+                    and np.dtype(iv.aval.dtype) == f32):
+                frontier.append(iv)
+    if has_accum:
+        return "accum"
+    if has_cast:
+        return "cast"
+    if budget <= 0 or frontier or not fully_resolved:
+        return "unknown"
+    return "local"
+
+
+@rule("dtype-promotion")
+def dtype_promotion(probe) -> list:
+    """f32 on a declared-bf16 compute path. Three shapes:
+
+    - a dot_general with MIXED float operand dtypes — jax promoted one
+      side (classic weak-type accident); HIGH.
+    - a dot_general whose f32 operand is directly the output of a
+      bf16->f32 `convert_element_type` — the matmul was meant to run on
+      the MXU in bf16 and someone upcast its input; HIGH. (bf16-in,
+      f32-accumulate matmuls — `preferred_element_type` — are the
+      CORRECT pattern and never flagged.)
+    - convert round trips a->b->a (any target): dead casts that cost a
+      pass over the array each way; MEDIUM.
+    """
+    out = []
+    bf16 = np.dtype(jax.numpy.bfloat16)
+    f32 = np.dtype(np.float32)
+    declared = (np.dtype(probe.compute_dtype)
+                if probe.compute_dtype is not None else None)
+
+    def dt(v):
+        d = getattr(v.aval, "dtype", None)
+        return None if d is None else np.dtype(d)
+
+    for ep in probe.entrypoints:
+        for jaxpr, path in probe.jaxpr_scopes(ep):
+            made_by = {}
+            for eqn in jaxpr.eqns:
+                for v in eqn.outvars:
+                    made_by[v] = eqn
+                name = eqn.primitive.name
+                if name == "convert_element_type":
+                    src = eqn.invars[0]
+                    prev = made_by.get(src)
+                    if (prev is not None
+                            and prev.primitive.name
+                            == "convert_element_type"
+                            and dt(prev.invars[0]) == dt(eqn.outvars[0])
+                            and dt(src) != dt(eqn.outvars[0])):
+                        # rank in the message anchors suppressions to a
+                        # value CLASS (rank-1 norm scales vs rank-5
+                        # attention probabilities), so suppressing one
+                        # cannot mask regressions of the other
+                        rank = len(getattr(eqn.outvars[0].aval,
+                                           "shape", ()))
+                        out.append(Finding(
+                            "dtype-promotion", Severity.MEDIUM,
+                            probe.name, ep.name, path,
+                            f"round-trip convert chain "
+                            f"{dt(prev.invars[0])}->{dt(src)}->"
+                            f"{dt(eqn.outvars[0])} on a rank-{rank} "
+                            f"intermediate — two dead passes over the "
+                            f"array"))
+                if name != "dot_general" or declared != bf16:
+                    continue
+                lhs, rhs = eqn.invars[:2]
+                dts = {dt(lhs), dt(rhs)}
+                if dts == {bf16, f32}:
+                    opnd = lhs if dt(lhs) == f32 else rhs
+                    origin = _f32_origin(opnd, made_by, bf16, f32)
+                    if origin in ("accum", "cast"):
+                        # the f32 side is (the transpose of) a matmul
+                        # that deliberately accumulates in f32
+                        # (`preferred_element_type`), or of an intended
+                        # downcast (`.astype(bf16)`) whose cotangent is
+                        # structurally f32 — the score path's documented
+                        # numerics; not an accident
+                        out.append(Finding(
+                            "dtype-promotion", Severity.LOW, probe.name,
+                            ep.name, path,
+                            f"mixed bf16/f32 dot_general on the f32 "
+                            f"accumulation path ({origin}: score-path "
+                            f"numerics / cast transpose) — intended, "
+                            f"costs f32-rate MXU for this matmul"))
+                    else:
+                        sev = (Severity.MEDIUM if origin == "unknown"
+                               else Severity.HIGH)
+                        out.append(Finding(
+                            "dtype-promotion", sev, probe.name,
+                            ep.name, path,
+                            "dot_general with mixed bf16/f32 operands "
+                            "on a declared-bf16 path — weak-type "
+                            "promotion runs this matmul in f32 (half "
+                            "MXU rate, 2x operand bytes)"
+                            + (" [f32 operand's producer is outside "
+                               "this scope]" if origin == "unknown"
+                               else "")))
+                    continue
+                if dts == {f32}:
+                    for opnd in (lhs, rhs):
+                        src = made_by.get(opnd)
+                        if (src is not None
+                                and src.primitive.name
+                                == "convert_element_type"
+                                and dt(src.invars[0]) == bf16):
+                            out.append(Finding(
+                                "dtype-promotion", Severity.HIGH,
+                                probe.name, ep.name, path,
+                                "f32 dot_general fed by a bf16->f32 "
+                                "upcast on a declared-bf16 path — the "
+                                "matmul should take bf16 operands "
+                                "(accumulate in f32 via "
+                                "preferred_element_type instead)"))
+                            break
+    return out
+
+
+# --------------------------------------------------------------- donation
+
+
+@rule("donation")
+def donation(probe) -> list:
+    """Step-like entrypoints must donate their params/opt-state args:
+    without `donate_argnums` XLA keeps input AND output copies of the
+    model live across the step — an extra params+moments of HBM that
+    the biggest configs cannot spare."""
+    out = []
+    for ep in probe.entrypoints:
+        if not ep.donate:
+            continue
+        pjit_eqn = probe.top_pjit(ep)
+        if pjit_eqn is None:
+            out.append(Finding(
+                "donation", Severity.HIGH, probe.name, ep.name,
+                (), "step-like entrypoint is not jitted — every call "
+                    "pays Python dispatch and nothing can be donated"))
+            continue
+        donated = pjit_eqn.params.get("donated_invars", ())
+        # flat invars are the flattened args in order; map each arg
+        # index to its leaf range
+        sizes = [len(jax.tree_util.tree_leaves(a)) for a in ep.args]
+        starts = np.cumsum([0] + sizes)
+        n_flat = len(donated)
+        for argi in ep.donate:
+            lo, hi = int(starts[argi]), int(starts[argi + 1])
+            if hi > n_flat or not all(donated[lo:hi]):
+                missing = ([] if hi > n_flat else
+                           [i for i in range(lo, hi) if not donated[i]])
+                out.append(Finding(
+                    "donation", Severity.HIGH, probe.name, ep.name,
+                    ("pjit",),
+                    f"argument {argi} ({ep.arg_names[argi]}) is not "
+                    f"donated ({len(missing) or hi - lo} of "
+                    f"{hi - lo} leaves un-aliased) — the step keeps a "
+                    f"second copy of those buffers live in HBM"))
+    return out
+
+
+# ------------------------------------------------------------- collective
+
+
+def _cycle_count(perm) -> int:
+    """Number of cycles in a permutation given as (src, dst) pairs."""
+    nxt = {int(s): int(d) for s, d in perm}
+    seen, cycles = set(), 0
+    for start in nxt:
+        if start in seen:
+            continue
+        cycles += 1
+        cur = start
+        while cur not in seen:
+            seen.add(cur)
+            cur = nxt.get(cur, cur)
+    return cycles
+
+
+@rule("collective")
+def collective(probe) -> list:
+    """Mesh-axis hygiene for every collective eqn: axis names must be
+    bound by an enclosing shard_map whose mesh matches the probe's; a
+    ppermute's permutation must be a bijection over in-range sources/
+    destinations, and on the pipeline ('pp') axis a SINGLE cycle —
+    stage hops here are rings, and a multi-cycle permutation would
+    partition the stages into disconnected sub-pipelines."""
+    out = []
+    probe_axes = set(probe.mesh.axis_names) if probe.mesh else set()
+    for ep in probe.entrypoints:
+        for eqn, path, env in probe.walk(ep):
+            name = eqn.primitive.name
+            if name == "shard_map" and probe.mesh is not None:
+                mesh = eqn.params.get("mesh")
+                if mesh is not None and not set(
+                        mesh.axis_names) <= probe_axes:
+                    out.append(Finding(
+                        "collective", Severity.HIGH, probe.name,
+                        ep.name, path,
+                        f"shard_map over mesh axes "
+                        f"{tuple(mesh.axis_names)} inside a program "
+                        f"whose constructing mesh has "
+                        f"{tuple(probe.mesh.axis_names)}"))
+                continue
+            key = _COLLECTIVES.get(name)
+            if key is None:
+                continue
+            axes = _axis_names(eqn.params.get(key))
+            unbound = [a for a in axes if a not in env]
+            if unbound:
+                out.append(Finding(
+                    "collective", Severity.HIGH, probe.name, ep.name,
+                    path,
+                    f"{name} over axis {unbound} not bound by any "
+                    f"enclosing shard_map (bound: "
+                    f"{sorted(env) or 'none'})"))
+                continue
+            if name != "ppermute":
+                continue
+            perm = tuple(eqn.params.get("perm", ()))
+            ax = axes[0] if axes else None
+            size = env.get(ax)
+            srcs = [int(s) for s, _ in perm]
+            dsts = [int(d) for _, d in perm]
+            if (len(set(srcs)) != len(srcs)
+                    or len(set(dsts)) != len(dsts)
+                    or (size is not None and any(
+                        not (0 <= x < size) for x in srcs + dsts))):
+                out.append(Finding(
+                    "collective", Severity.HIGH, probe.name, ep.name,
+                    path,
+                    f"ppermute over '{ax}' (size {size}) with an "
+                    f"invalid permutation {perm}: duplicate or "
+                    f"out-of-range sources/destinations"))
+                continue
+            if ax == "pp" and perm and (
+                    len(perm) != size or _cycle_count(perm) != 1):
+                out.append(Finding(
+                    "collective", Severity.HIGH, probe.name, ep.name,
+                    path,
+                    f"ppermute over 'pp' is not a single "
+                    f"{size}-cycle ({perm}): pipeline stage hops "
+                    f"must form one ring, or stages de-sync into "
+                    f"disconnected sub-pipelines"))
+    return out
+
+
+# ---------------------------------------------------------------- retrace
+
+
+@rule("retrace")
+def retrace(probe) -> list:
+    """>1 compilation per entrypoint after the probe exercised it with
+    the shape/dtype set the test suite uses. Every extra executable is
+    seconds of XLA compile time and a sign the cache key is unstable
+    (python scalars re-traced as weak types, shifting shapes, ...)."""
+    out = []
+    for ep in probe.entrypoints:
+        # read the snapshot TargetProbe.seal() took right after the
+        # exercise calls — not the live cache, which later rules'
+        # make_jaxpr tracing could perturb on some jax versions
+        n = ep.observed_compiles
+        if n is None or ep.calls == 0:
+            continue
+        if n > ep.n_compiles_expected:
+            out.append(Finding(
+                "retrace", Severity.HIGH, probe.name, ep.name, (),
+                f"{n} compilations after {ep.calls} same-shaped calls "
+                f"(expected {ep.n_compiles_expected}) — the jit cache "
+                f"key is unstable for this entrypoint"))
+    return out
+
+
+# ------------------------------------------------------- memory highwater
+
+
+@rule("memory-highwater")
+def memory_highwater(probe) -> list:
+    """Static live-buffer high-water per entrypoint jaxpr vs the
+    probe's budget. Always emits one LOW informational finding per
+    entrypoint (the number lands in the report snapshot); HIGH when the
+    estimate exceeds the budget."""
+    out = []
+    for ep in probe.entrypoints:
+        jaxpr = probe.jaxpr_of(ep)
+        if jaxpr is None:
+            continue
+        est = peak_bytes(jaxpr.jaxpr)
+        args_b = sum(aval_bytes(v.aval) for v in jaxpr.jaxpr.invars)
+        mib = est / (1 << 20)
+        if est > probe.hbm_budget:
+            out.append(Finding(
+                "memory-highwater", Severity.HIGH, probe.name, ep.name,
+                (),
+                f"estimated live-buffer peak {mib:.1f} MiB exceeds the "
+                f"{probe.hbm_budget / (1 << 20):.0f} MiB budget "
+                f"(inputs alone: {args_b / (1 << 20):.1f} MiB)"))
+        else:
+            out.append(Finding(
+                "memory-highwater", Severity.LOW, probe.name, ep.name,
+                (),
+                f"estimated live-buffer peak {mib:.2f} MiB "
+                f"(budget {probe.hbm_budget / (1 << 20):.0f} MiB)"))
+    return out

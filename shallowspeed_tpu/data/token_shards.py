@@ -62,7 +62,8 @@ def build_shards(tokens: np.ndarray, out_dir, vocab: int,
     out.mkdir(parents=True, exist_ok=True)
     tokens = np.asarray(tokens)
     assert tokens.ndim == 1 and len(tokens) > 0, tokens.shape
-    assert int(tokens.max()) < vocab, (tokens.max(), vocab)
+    assert int(tokens.min()) >= 0 and int(tokens.max()) < vocab, (
+        tokens.min(), tokens.max(), vocab)
     dt = _token_dtype(vocab)
     assert val is None or not val_fraction, (
         "pass EITHER an explicit val array or val_fraction")
@@ -70,6 +71,12 @@ def build_shards(tokens: np.ndarray, out_dir, vocab: int,
         val = np.asarray(val)
         n_val = len(val)
         assert n_val > 0, "explicit val split is empty"
+        # same range check train tokens get above: out-of-range ids
+        # would silently WRAP in the narrowing astype below and only
+        # surface as corrupt val batches much later
+        assert int(val.min()) >= 0 and int(val.max()) < vocab, (
+            f"explicit val ids outside [0, {vocab}): "
+            f"min={int(val.min())}, max={int(val.max())}")
         val.astype(dt).tofile(out / _VAL)
     else:
         n_val = int(len(tokens) * val_fraction)

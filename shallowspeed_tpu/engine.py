@@ -23,12 +23,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# version-compat shard_map (utils.py): VMA jax as-is; pre-VMA jax
+# with the legacy replication rewriter disabled
+from shallowspeed_tpu.utils import shard_map
 
 from shallowspeed_tpu.models.mlp import MLPStage, accumulate_grads, zero_grads_like
+from shallowspeed_tpu.utils import pvary_over as _pvary
 
 tree_map = jax.tree_util.tree_map
 
@@ -74,7 +74,7 @@ class FusedDPEngine:
 
             # the zero init is axis-invariant but the accumulated grads vary
             # per dp shard — cast the carry to varying for shard_map's typing
-            acc0 = jax.lax.pcast(zero_grads_like(params), ("dp",), to="varying")
+            acc0 = _pvary(zero_grads_like(params), ("dp",))
             acc, _ = jax.lax.scan(mu_body, acc0, (x_mu, y_mu))
             total = tree_map(lambda g: jax.lax.psum(g, "dp"), acc)
             return opt_ref.step(params, total, opt_state)

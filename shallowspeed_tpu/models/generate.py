@@ -272,13 +272,23 @@ def _sample(logits, rng, temperature: float, top_k: int,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+FLASH_PREFILL_THRESHOLD = 2048
+"""Prompt-BUCKET length at which `generate` switches the prefill from
+XLA attention to the flash kernel (long prompts OOM on the (B, H, Tp,
+Tp) f32 score materialization). Flash numerics differ at the ~1e-6
+level, so sampled token streams across the switch are NOT bit-identical
+— callers who need cross-length stream stability pin
+`flash_prefill_at` in `generate` instead of relying on the default."""
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_new", "temperature",
                                    "top_k", "top_p", "cache_len",
-                                   "kv_quant"))
+                                   "kv_quant", "flash_prefill_at"))
 def _generate_padded(params, prompt, tp_actual, cfg: T.TransformerConfig,
                      max_new: int, temperature: float, top_k: int,
                      top_p: float, seed, cache_len: int,
-                     kv_quant: str = ""):
+                     kv_quant: str = "",
+                     flash_prefill_at: int = FLASH_PREFILL_THRESHOLD):
     """The compiled generation core on a BUCKET-padded prompt (B, Tp_b):
     `tp_actual` is the TRACED true prompt length, so every prompt in the
     same (Tp_b, max_new, sampler) bucket reuses one executable. The KV
@@ -290,13 +300,14 @@ def _generate_padded(params, prompt, tp_actual, cfg: T.TransformerConfig,
     cache = init_kv_cache(cfg, b, cache_len, kv_quant)
     # long prompts stream the prefill through the flash kernel (the
     # XLA path materializes (B, H, Tp, Tp) f32 scores); prompts that
-    # bucket BELOW 2048 keep the XLA path, so their streams stay
-    # bit-identical to earlier rounds. Guard the tile size too: a
+    # bucket BELOW the threshold keep the XLA path, so their streams
+    # stay bit-identical to earlier rounds. Guard the tile size too: a
     # non-power-of-two length shrinks the Pallas block toward 1 (a
     # silent performance cliff worse than the OOM it avoids).
     from shallowspeed_tpu.ops.flash_attention import _pick_block
 
-    attn_impl = ("flash" if prompt.shape[1] >= 2048
+    attn_impl = ("flash" if flash_prefill_at > 0
+                 and prompt.shape[1] >= flash_prefill_at
                  and _pick_block(prompt.shape[1], 512) >= 128
                  else "xla")
     logits, cache = prefill(params, prompt, cfg, cache,
@@ -334,7 +345,8 @@ def prompt_bucket_len(tp: int, max_new: int, max_seq: int,
 
 def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
              temperature: float = 1.0, top_k: int = 0,
-             top_p: float = 0.0, seed=0, kv_quant: str = ""):
+             top_p: float = 0.0, seed=0, kv_quant: str = "",
+             flash_prefill_at: int = FLASH_PREFILL_THRESHOLD):
     """Generate `max_new` tokens after `prompt` (B, Tp). Returns
     (B, max_new) int32.
 
@@ -346,6 +358,16 @@ def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
     to the unpadded form — the pad slots are overwritten before the
     position mask can admit them.
 
+    **Stream-stability contract.** For a fixed (seed, sampler, weights)
+    the token stream is reproducible across runs and prompt paddings,
+    with two documented exceptions: (1) prompts whose 64-token BUCKET
+    reaches `flash_prefill_at` (default 2048) prefill through the flash
+    kernel, whose numerics differ from XLA attention at the ~1e-6
+    logit level — so streams are bit-stable WITHIN each regime but not
+    across the switch. Callers needing one numerics regime for every
+    length pin it: `flash_prefill_at=0` disables the auto-switch (XLA
+    everywhere — long prompts then pay the (B, H, Tp, Tp) f32 score
+    materialization), any other value moves the boundary. (2)
     `kv_quant="int8"` (round 5): quantized KV cache — halves the
     cache-sweep bytes for batched long-context decode at a small
     numerics cost (per-head absmax scales; logits move at the ~1e-2
@@ -359,4 +381,5 @@ def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
     return _generate_padded(params, prompt, jnp.int32(tp), cfg, max_new,
                             temperature, top_k, top_p, seed,
                             cache_len=tp_b + max_new,
-                            kv_quant=kv_quant)
+                            kv_quant=kv_quant,
+                            flash_prefill_at=flash_prefill_at)

@@ -248,15 +248,31 @@ def init(cfg: TransformerConfig, seed: int = 0):
     return out
 
 
+_NORM_KEYS = {"ln1", "ln2", "ln_f"}
+
+
 def cast_params(params, compute_dtype):
     """Mixed-precision boundary: float leaves to `compute_dtype` (None =
     identity; casting twice is free — same-dtype astype returns the
-    operand). Shared by training forward and the decode path."""
+    operand). Shared by training forward and the decode path.
+
+    Norm parameters (ln1/ln2/ln_f) stay in the MASTER dtype: every
+    consumer immediately recasts them to f32 for the statistics
+    (`_layernorm`/`_rmsnorm`, `zb.norm_fwd`), so a bf16 cast here would
+    only quantize the scales and pay a dead f32->bf16->f32 round trip
+    per use — the `analysis` dtype rule's round-trip finding (round 6).
+    Norm OUTPUTS are cast to the activation dtype as before, so every
+    matmul's operand dtypes are unchanged."""
     if compute_dtype is None:
         return params
-    return jax.tree_util.tree_map(
-        lambda p: p.astype(compute_dtype)
-        if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+    def cast(path, p):
+        if any(getattr(k, "key", None) in _NORM_KEYS for k in path):
+            return p
+        return (p.astype(compute_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
 
 
 def _layernorm(p, x, eps=1e-5):

@@ -46,11 +46,31 @@ import jax
 import jax.numpy as jnp
 from jax import Array, lax
 
+from shallowspeed_tpu.analysis.findings import suppress
+
 # plain float, NOT jnp.float32: a module-level jnp constant would
 # initialize the XLA backend at import time, which forbids a later
 # `jax.distributed.initialize` (multi-controller runs import this
 # package before calling `distributed.initialize`)
 _NEG = -1e30
+
+# Intentional `analysis` finding (dtype-promotion, MEDIUM): under bf16
+# compute the attention probabilities round-trip f32->bf16->f32 once per
+# block — softmax emits f32 (stability contract, see `attention`), the
+# AV matmul consumes `p.astype(v.dtype)` (the MXU pass), and the
+# backward needs the f32 probabilities again. The pair is the transpose
+# of the primal's deliberate downcast, not a dead cast to remove: both
+# endpoints are load-bearing dtypes. The match is ANCHORED to rank-5
+# values — the grouped (b, kvh, g, q, k) probability tensor — so this
+# suppression cannot mask, e.g., a reintroduction of the dead rank-1
+# norm-scale round trips `cast_params` fixed in the same round.
+suppress("dtype-promotion", match="round-trip convert chain "
+         "float32->bfloat16->float32 on a rank-5",
+         reason="attention-probability cast pair: softmax is f32 by the "
+                "score-path stability contract, the AV matmul runs bf16 "
+                "on the MXU, and the backward reuses the f32 "
+                "probabilities — the round trip IS the mixed-precision "
+                "boundary (ops/attention.py)")
 
 
 def _group(q: Array, kvh: int):

@@ -29,10 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# version-compat shard_map (utils.py): VMA jax as-is; pre-VMA jax
+# with the legacy replication rewriter disabled
+from shallowspeed_tpu.utils import shard_map
 
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.ops.attention import (attention, ring_attention,

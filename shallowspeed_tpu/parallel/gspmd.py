@@ -98,8 +98,19 @@ class GSPMDEngine:
                 opt, self.params, self.opt_state)
             self._step_fn = None
         else:
+            # pin the step's outputs to the DECLARED placements
+            # (params: param_specs; moments: their live placement; loss:
+            # replicated). Left unpinned, GSPMD is free to emit e.g. a
+            # tp-sharded pos_emb when the gradient math makes that
+            # locally cheaper — the second step then sees different
+            # input shardings than the first and silently recompiles,
+            # and the resting placement drifts from param_specs forever
+            # after (caught by `analysis`'s retrace rule, round 6).
+            out_sh = (self.shardings,
+                      tree_map(lambda l: l.sharding, self.opt_state),
+                      self.rep)
 
-            @partial(jax.jit, donate_argnums=(0, 1))
+            @partial(jax.jit, donate_argnums=(0, 1), out_shardings=out_sh)
             def _step(params, opt_state, tokens, targets, step):
                 loss, grads = jax.value_and_grad(
                     lambda p: T.loss(p, tokens, targets, cfg,

@@ -96,10 +96,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# version-compat shard_map (utils.py): VMA jax as-is; pre-VMA jax
+# with the legacy replication rewriter disabled
+from shallowspeed_tpu.utils import shard_map
 
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.ops.attention import attention
@@ -1220,6 +1219,21 @@ class PipelineLMEngine:
         # interleaved engine executes. Memory trades the 1F1B
         # recompute-stash for full residual stashes (the ZB paper's
         # deal); slot counts in the tables are measured peaks.
+        #
+        # Cost caveat (ADVICE r5): zb_stage_fwd/zb_stage_bwd compute
+        # the FULL-VOCAB head NLL (and its vjp) on EVERY stage each F/B
+        # round, masked to zero off the last stage — correct and
+        # SPMD-uniform, exactly like the 1F1B path. At large vocab the
+        # head matmul is a growing constant added to every F and B
+        # round, which inflates their unit cost beyond the ZB paper's
+        # F≈B≈W assumption that the schedule's zero-bubble accounting
+        # relies on: expect the realized bubble win to shrink as
+        # vocab/d_model grows (the W rounds carry no head work). Gating
+        # the head behind the last-stage predicate would fix the FLOPs
+        # but put a cond around stage compute — the same de-sync
+        # hazard the 1F1B path documents for its uniform mode — so the
+        # cost is documented rather than branched away; benchmark
+        # regressions at big vocab start here, not in the schedule.
         if self.schedule == "zb":
             from shallowspeed_tpu.parallel import zb as ZB
             from shallowspeed_tpu.parallel.verify import zb_tables

@@ -43,10 +43,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# version-compat shard_map (utils.py): VMA jax as-is; pre-VMA jax
+# with the legacy replication rewriter disabled
+from shallowspeed_tpu.utils import shard_map
 
 from shallowspeed_tpu.models.mlp import init_linear_np, stage_layer_sizes
 from shallowspeed_tpu.utils import pvary_over as _pvary

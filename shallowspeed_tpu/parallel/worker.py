@@ -42,10 +42,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exports shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# version-compat shard_map (utils.py): VMA jax as-is; pre-VMA jax
+# with the legacy replication rewriter disabled
+from shallowspeed_tpu.utils import shard_map
 
 from shallowspeed_tpu.models.mlp import MLPStage
 from shallowspeed_tpu.parallel.instructions import (

@@ -59,6 +59,48 @@ def assert_replicas_in_sync(params: Any) -> None:
                     f"{key}: {sorted(hashes)}")
 
 
+# Does this jax generation type shard_map values by varying-manual-axes
+# (VMA)? Gates BOTH compat shims below: on VMA jax, `pvary_over` does the
+# carry/branch typing and shard_map's default checking IS that typing; on
+# pre-VMA jax, pvary has nothing to do and the old rewrite-based
+# replication checker (which predates several primitives these engines
+# trace) must be disabled instead.
+_HAS_VMA = hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+
+
+def shard_map(f=None, **kw):
+    """`jax.shard_map` across API generations (drop-in for the engines'
+    `partial(shard_map, mesh=..., in_specs=..., out_specs=...)` idiom).
+    On pre-VMA jax, passes `check_rep=False`: the engines' programs are
+    variance-typed for VMA shard_map, and the legacy replication
+    rewriter rejects primitives they rely on (scan-carried ppermute
+    chains and friends) with "No replication rule". The collective
+    structure itself is unchanged — `analysis`'s collective rule and the
+    cross-engine parity tests check it, not the legacy rewriter."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as _sm
+    if not _HAS_VMA:
+        kw.setdefault("check_rep", False)
+    if f is None:
+        return lambda g: _sm(g, **kw)
+    return _sm(f, **kw)
+
+
+def _pvary_leaf(leaf, ax: str):
+    """One leaf to 'varying' over `ax`, across jax API generations:
+    `lax.pcast(..., to="varying")` (newest), `lax.pvary` (the rename it
+    shipped under first), or identity on pre-VMA jax — there shard_map
+    has no varying-manual-axes types, so the cast has nothing to do."""
+    lax = jax.lax
+    if hasattr(lax, "pcast"):
+        return lax.pcast(leaf, (ax,), to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(leaf, (ax,))
+    return leaf
+
+
 def pvary_over(tree: Any, axes: tuple[str, ...]) -> Any:
     """Cast a pytree to 'varying' over the given shard_map mesh axes (VMA).
 
@@ -70,7 +112,7 @@ def pvary_over(tree: Any, axes: tuple[str, ...]) -> Any:
     def cast(leaf):
         for ax in axes:
             try:
-                leaf = jax.lax.pcast(leaf, (ax,), to="varying")
+                leaf = _pvary_leaf(leaf, ax)
             except ValueError:
                 pass  # already varying over this axis
         return leaf

@@ -1,0 +1,263 @@
+"""Static analysis (`shallowspeed_tpu.analysis`) tests.
+
+Two layers:
+
+- **Per-rule toy fixtures**: one intentionally-bad jitted program per
+  rule (accidental f32 promotion, missing donation, foreign-mesh
+  collective, multi-cycle pp ppermute, unstable jit cache, over-budget
+  memory) asserting the rule FIRES, plus a clean twin asserting it
+  stays quiet — the rules are tested like any other pure function.
+- **The tier-1 gate**: every shipped compiled train-step family
+  (pipeline_lm GPipe/1F1B/interleaved/ZB-H1, gspmd, spmd_pipeline,
+  engine) must analyze to ZERO unsuppressed high-severity findings.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from shallowspeed_tpu import analysis
+from shallowspeed_tpu.analysis import (EntryPoint, Severity, TargetProbe,
+                                       gate_count, run_rules)
+from shallowspeed_tpu.analysis.findings import (clear_suppressions,
+                                                registered_suppressions,
+                                                suppress)
+from shallowspeed_tpu.analysis.targets import TARGET_BUILDERS
+from shallowspeed_tpu.utils import shard_map
+
+
+def toy_probe(fn, args, donate=(), mesh=None, compute_dtype=None,
+              calls=0, budget=16 << 30, name="toy"):
+    probe = TargetProbe(name, mesh, compute_dtype, hbm_budget=budget)
+    probe.entrypoints = [EntryPoint(
+        "fn", fn, tuple(args), tuple(f"arg{i}" for i in range(len(args))),
+        donate=tuple(donate), calls=calls)]
+    return probe.seal()
+
+
+def sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def highs(findings):
+    return [f for f in findings
+            if f.severity == Severity.HIGH and not f.suppressed]
+
+
+# ------------------------------------------------------- dtype promotion
+
+
+def test_dtype_rule_fires_on_weak_promotion():
+    @jax.jit
+    def bad(x):  # bf16 activations against a forgotten-f32 constant
+        return x @ jnp.ones((8, 8), jnp.float32)
+
+    probe = toy_probe(bad, [sds((4, 8), jnp.bfloat16)],
+                      compute_dtype=jnp.bfloat16)
+    assert highs(run_rules(probe, only=("dtype-promotion",)))
+
+
+def test_dtype_rule_fires_on_upcast_matmul():
+    @jax.jit
+    def bad(x, w):  # bf16 data upcast, then an all-f32 matmul
+        return x.astype(jnp.float32) @ w
+
+    probe = toy_probe(
+        bad, [sds((4, 8), jnp.bfloat16), sds((8, 8), jnp.float32)],
+        compute_dtype=jnp.bfloat16)
+    assert highs(run_rules(probe, only=("dtype-promotion",)))
+
+
+def test_dtype_rule_quiet_on_f32_accumulation():
+    @jax.jit
+    def clean(q, k, v):  # the documented score-path pattern
+        s = jnp.einsum("qd,kd->qk", q, k,
+                       preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("qk,kd->qd", p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32)
+
+    args = [sds((4, 8), jnp.bfloat16)] * 3
+    probe = toy_probe(clean, args, compute_dtype=jnp.bfloat16)
+    assert not highs(run_rules(probe, only=("dtype-promotion",)))
+
+
+def test_dtype_rule_flags_round_trip_convert():
+    @jax.jit
+    def smelly(x):
+        return x.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+
+    probe = toy_probe(smelly, [sds((16,), jnp.float32)])
+    fs = run_rules(probe, only=("dtype-promotion",))
+    assert any("round-trip" in f.message for f in fs)
+    assert not highs(fs)  # MEDIUM: a smell, not a gate
+
+
+# --------------------------------------------------------------- donation
+
+
+def test_donation_rule_fires_on_undonated_step():
+    @jax.jit
+    def step(params, opt, x):
+        return params + x.sum(), opt + 1.0
+
+    args = [sds((8,), jnp.float32), sds((), jnp.float32),
+            sds((4,), jnp.float32)]
+    probe = toy_probe(step, args, donate=(0, 1))
+    found = highs(run_rules(probe, only=("donation",)))
+    assert len(found) == 2  # params AND opt-state un-donated
+
+
+def test_donation_rule_quiet_when_donated():
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt, x):
+        return params + x.sum(), opt + 1.0
+
+    args = [sds((8,), jnp.float32), sds((), jnp.float32),
+            sds((4,), jnp.float32)]
+    probe = toy_probe(step, args, donate=(0, 1))
+    assert not run_rules(probe, only=("donation",))
+
+
+# ------------------------------------------------------------- collective
+
+
+def mesh2x2(names=("dp", "pp")):
+    return Mesh(np.array(jax.devices()[:4]).reshape(2, 2), names)
+
+
+def test_collective_rule_fires_on_foreign_mesh():
+    foreign = Mesh(np.array(jax.devices()[:2]), ("foo",))
+
+    @jax.jit
+    @partial(shard_map, mesh=foreign, in_specs=P("foo"), out_specs=P())
+    def prog(x):
+        return jax.lax.psum(x, "foo")
+
+    probe = toy_probe(prog, [sds((4,), jnp.float32)], mesh=mesh2x2())
+    found = highs(run_rules(probe, only=("collective",)))
+    assert found and "foo" in found[0].message
+
+
+def test_collective_rule_fires_on_multi_cycle_pp_ppermute():
+    @jax.jit
+    @partial(shard_map, mesh=mesh2x2(), in_specs=P("dp", "pp"),
+             out_specs=P("dp", "pp"))
+    def prog(x):  # two self-loops: stages never exchange
+        return jax.lax.ppermute(x, "pp", [(0, 0), (1, 1)])
+
+    probe = toy_probe(prog, [sds((4, 4), jnp.float32)], mesh=mesh2x2())
+    found = highs(run_rules(probe, only=("collective",)))
+    assert found and "single" in found[0].message
+
+
+def test_collective_rule_quiet_on_ring():
+    @jax.jit
+    @partial(shard_map, mesh=mesh2x2(), in_specs=P("dp", "pp"),
+             out_specs=P("dp", "pp"))
+    def prog(x):
+        x = jax.lax.ppermute(x, "pp", [(0, 1), (1, 0)])
+        return jax.lax.psum(x, "dp") * 0.5
+
+    probe = toy_probe(prog, [sds((4, 4), jnp.float32)], mesh=mesh2x2())
+    assert not highs(run_rules(probe, only=("collective",)))
+
+
+# ---------------------------------------------------------------- retrace
+
+
+def test_retrace_rule_fires_on_unstable_cache():
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    f(jnp.ones((4,)))
+    f(jnp.ones((8,)))  # a second executable
+    probe = toy_probe(f, [sds((4,), jnp.float32)], calls=2)
+    assert highs(run_rules(probe, only=("retrace",)))
+
+
+def test_retrace_rule_quiet_on_stable_cache():
+    @jax.jit
+    def f(x):
+        return x * 3
+
+    f(jnp.ones((4,)))
+    f(jnp.ones((4,)) + 1)
+    probe = toy_probe(f, [sds((4,), jnp.float32)], calls=2)
+    assert not run_rules(probe, only=("retrace",))
+
+
+# ------------------------------------------------------- memory highwater
+
+
+def test_memory_rule_fires_over_budget():
+    @jax.jit
+    def big(x):
+        y = jnp.outer(x, x)          # (2048, 2048) f32 = 16 MiB live
+        return y.sum()
+
+    probe = toy_probe(big, [sds((2048,), jnp.float32)],
+                      budget=1 << 20)  # 1 MiB
+    assert highs(run_rules(probe, only=("memory-highwater",)))
+
+
+def test_memory_rule_quiet_within_budget():
+    @jax.jit
+    def small(x):
+        return (x * 2).sum()
+
+    probe = toy_probe(small, [sds((64,), jnp.float32)])
+    fs = run_rules(probe, only=("memory-highwater",))
+    assert fs and not highs(fs)  # informational LOW only
+
+
+# ------------------------------------------------------------ suppression
+
+
+def test_suppression_marks_and_ungates():
+    @jax.jit
+    def step(params, x):
+        return params + x.sum()
+
+    snapshot = registered_suppressions()
+    try:
+        suppress("donation", target="toy-sup", match="not donated",
+                 reason="toy fixture: documents the mechanism")
+        probe = toy_probe(step, [sds((8,), jnp.float32),
+                                 sds((4,), jnp.float32)],
+                          donate=(0,), name="toy-sup")
+        fs = run_rules(probe, only=("donation",))
+        assert fs and all(f.suppressed for f in fs)
+        assert gate_count(fs) == 0
+        assert "toy fixture" in fs[0].format()
+    finally:
+        clear_suppressions(snapshot)
+
+
+def test_suppression_requires_reason():
+    with pytest.raises(AssertionError):
+        suppress("donation", reason="   ")
+
+
+# ----------------------------------------------- the tier-1 clean gate
+
+
+@pytest.mark.parametrize("target", sorted(TARGET_BUILDERS))
+def test_shipped_train_steps_are_tpu_clean(target):
+    """THE acceptance gate: every compiled train-step family ships with
+    zero unsuppressed high-severity findings."""
+    results = analysis.analyze(target)
+    gating = [f for fs in results.values() for f in fs
+              if f.severity == Severity.HIGH and not f.suppressed]
+    assert not gating, "\n".join(f.format() for f in gating)
+
+
+def test_cli_exits_zero_on_clean_target():
+    from shallowspeed_tpu.analysis.__main__ import main
+
+    assert main(["--target", "engine", "-q"]) == 0
