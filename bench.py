@@ -21,6 +21,18 @@ XLA dispatch — fraction-of-peak on the detected chip
 (`shallowspeed_tpu/flops.py`), the metric the MLP workload is too small
 to exercise.
 
+Load robustness (round 6, VERDICT r5 weak #1: best-of-3 was evidently
+load-sensitive — the r5 driver capture regressed ~14% below the
+builder's re-run): the TPU and NumPy measurements now run as
+INTERLEAVED rounds (t, n, t, n, ...) aggregated by MEDIAN, so a host
+load transient hits both sides of the ratio instead of whichever
+happened to be running, and a single spike cannot become the reported
+number. The JSON records every round, the spread, and host-load
+diagnostics (1/5/15-min loadavg, runnable-process count, cpu count)
+with an `idle_host` verdict — a bench line captured under load now
+SAYS so. Done-bar: two back-to-back runs agree within ±2% on
+`vs_baseline` (pinned denominator) and `transformer_mfu`.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
@@ -87,33 +99,39 @@ def numpy_baseline_step_fn():
     return step
 
 
-def bench_numpy(xs, ys, n_batches=60) -> float:
-    """Sustained NumPy samples/sec, measured over a subset and scaled (the
-    full 20-epoch run would take minutes). Best of 3 runs: host/BLAS load
-    jitter only ever makes NumPy look slower, so taking its fastest run
-    keeps `vs_baseline` conservative and stable across invocations."""
+def numpy_round_fn(xs, ys, n_batches=60):
+    """One warmed-up NumPy measurement round: () -> samples/sec over
+    `n_batches` batches (the full 20-epoch run would take minutes)."""
     step = numpy_baseline_step_fn()
     for _ in range(3):
-        step(xs, ys)
-    best = 0.0
-    for _ in range(3):
+        step(xs, ys)  # warmup (allocator, BLAS thread pools)
+
+    def one_round() -> float:
         t0 = time.perf_counter()
         for _ in range(n_batches):
             step(xs, ys)
         dt = time.perf_counter() - t0
-        best = max(best, n_batches * GBS / dt)
-    return best
+        return n_batches * GBS / dt
+
+    return one_round
+
+
+def bench_numpy(xs, ys, n_batches=60, rounds=3) -> float:
+    """Median sustained NumPy samples/sec (kept for parity tests and
+    one-off use; `main` interleaves the rounds with the TPU side)."""
+    one = numpy_round_fn(xs, ys, n_batches)
+    return float(np.median([one() for _ in range(rounds)]))
 
 
 # ------------------------------------------------------------ jax/tpu side
 
 
-def bench_tpu(xs, ys, n_batches=BENCH_BATCHES) -> float:
-    """Steady-state training throughput: the whole EPOCHS-epoch run compiled
-    into ONE XLA dispatch (scan over epochs of scan over batches), data
-    HBM-resident. Staging is excluded from the timed region — the NumPy
-    baseline's data is likewise pre-generated in RAM — and the run is
-    repeated 3x, reporting the best, to suppress host/tunnel jitter."""
+def tpu_round_fn(xs, ys, n_batches=BENCH_BATCHES):
+    """One warmed-up TPU measurement round: () -> samples/sec for the
+    whole EPOCHS-epoch run compiled into ONE XLA dispatch (scan over
+    epochs of scan over batches), data HBM-resident. Staging and the
+    compile are excluded from the timed region — the NumPy baseline's
+    data is likewise pre-generated in RAM."""
     import jax
 
     from shallowspeed_tpu.engine import FusedDPEngine
@@ -142,14 +160,105 @@ def bench_tpu(xs, ys, n_batches=BENCH_BATCHES) -> float:
     eng.train_run(staged, EPOCHS)  # compile warmup (excluded)
     sync()
 
-    best = 0.0
-    for _ in range(3):
+    def one_round() -> float:
         t0 = time.perf_counter()
         eng.train_run(staged, EPOCHS)
         sync()
         dt = time.perf_counter() - t0
-        best = max(best, (EPOCHS * n_batches) * GBS / dt)
-    return best
+        return (EPOCHS * n_batches) * GBS / dt
+
+    return one_round
+
+
+def bench_tpu(xs, ys, n_batches=BENCH_BATCHES, rounds=3) -> float:
+    """Median steady-state throughput (kept for one-off use; `main`
+    interleaves the rounds with the NumPy side)."""
+    one = tpu_round_fn(xs, ys, n_batches)
+    return float(np.median([one() for _ in range(rounds)]))
+
+
+# ----------------------------------------------------- load robustness
+
+
+def host_load_diagnostics(self_load: float = 0.0) -> dict:
+    """Who else is on this host right now: 1/5/15-min loadavg, the
+    runnable-process count (/proc/stat procs_running), total process
+    count, cpu count, and an `idle_host` verdict (1-min loadavg under
+    half the cpus — plus `self_load`, the bench's own expected
+    contribution, for the AFTER sample: minutes of interleaved rounds
+    legitimately push loadavg by ~1 on a small host and must not make
+    every run self-report as contaminated — and nothing else
+    runnable; procs_running already excludes us via the +1). Recorded
+    IN the bench JSON so a number captured under load says so — this
+    host's own BASELINE.md documents 25x stalls from concurrent load."""
+    import os
+
+    ncpu = os.cpu_count() or 1
+    try:
+        la1, la5, la15 = os.getloadavg()
+    except OSError:  # pragma: no cover — non-UNIX
+        la1 = la5 = la15 = -1.0
+    procs_running = None
+    try:
+        for line in open("/proc/stat"):
+            if line.startswith("procs_running"):
+                # includes this bench process itself
+                procs_running = int(line.split()[1])
+                break
+    except OSError:  # pragma: no cover — non-Linux
+        pass
+    n_procs = None
+    try:
+        n_procs = sum(1 for d in os.listdir("/proc") if d.isdigit())
+    except OSError:  # pragma: no cover — non-Linux
+        pass
+    idle = (la1 < 0.5 * ncpu + self_load
+            and (procs_running is None or procs_running <= ncpu + 1))
+    return {"loadavg": [round(la1, 2), round(la5, 2), round(la15, 2)],
+            "cpus": ncpu, "procs_running": procs_running,
+            "n_processes": n_procs, "idle_host": bool(idle)}
+
+
+def interleaved_medians(round_fns: dict, rounds: int = 5,
+                        max_extra: int = 4,
+                        spread_target: float = 0.10,
+                        gate: tuple = ()) -> dict:
+    """Run each side's measurement round back-to-back within every
+    round (t, n, t, n, ...) and aggregate by median: a load transient
+    lands on both sides of the ratio instead of one, and one spike
+    cannot become the reported number. When the spread ((max-min)/
+    median) still exceeds `spread_target` after the base rounds — a
+    load transient hit several rounds — up to `max_extra` additional
+    interleaved rounds are run so the median sits on more samples.
+    `gate` names the sides whose spread drives that extension (default:
+    all); main() gates on the TPU side only — the numpy live number is
+    diagnostics, and BLAS jitter alone must not buy four more full
+    TPU rounds. Returns per-side {median, rounds, spread}."""
+    samples: dict[str, list] = {k: [] for k in round_fns}
+
+    def one_round():
+        for name, fn in round_fns.items():
+            samples[name].append(fn())
+
+    def spread(vals):
+        return (max(vals) - min(vals)) / float(np.median(vals))
+
+    for _ in range(rounds):
+        one_round()
+    extra = 0
+    gated = gate or tuple(round_fns)
+    while extra < max_extra and any(
+            spread(samples[k]) > spread_target for k in gated):
+        one_round()
+        extra += 1
+    out = {}
+    for name, vals in samples.items():
+        out[name] = {
+            "median": float(np.median(vals)),
+            "rounds": [round(v, 1) for v in vals],
+            "spread": round(spread(vals), 4),
+        }
+    return out
 
 
 def bench_transformer_mfu():
@@ -283,8 +392,12 @@ def bench_kernel_numerics():
         qh = q[:, t2:]
         (_, _, _, _, kvh_, _, bq, bk, nqb_chunk) = FA._ring_geometry(
             qh, k[:, :t2])
+        # out_dtype f32: the exact chunk-output dtype the ring passes
+        # (round 6 — the bf16 chunk rounding was the r5 2.3x-above-
+        # floor finding; BASELINE.md 'ring-chunk numerics envelope')
         kw = dict(causal=True, window=0, bq=bq, bk=bk,
-                  nqb_chunk=nqb_chunk, interpret=False)
+                  nqb_chunk=nqb_chunk, interpret=False,
+                  out_dtype=jnp.float32)
         q3 = FA._fold_q(qh, kvh_)
 
         @jax.jit
@@ -334,8 +447,14 @@ def main():
     ys[np.arange(GBS), labels] = 1.0
     ys = ys.reshape(N_MU, GBS // N_MU, 10)
 
-    tpu_sps = bench_tpu(xs, ys)
-    np_live = bench_numpy(xs, ys)
+    load_before = host_load_diagnostics()
+    meas = interleaved_medians({
+        "tpu": tpu_round_fn(xs, ys),
+        "numpy": numpy_round_fn(xs, ys),
+    }, rounds=7, gate=("tpu",))
+    load_after = host_load_diagnostics(self_load=1.0)
+    tpu_sps = meas["tpu"]["median"]
+    np_live = meas["numpy"]["median"]
     np_pinned = pinned_baseline()
 
     out = {
@@ -345,6 +464,15 @@ def main():
         "vs_baseline": round(tpu_sps / (np_pinned or np_live), 2),
         "baseline_pinned": np_pinned is not None,
         "numpy_live_sps": round(np_live, 1),
+        # load-robustness record (VERDICT r5 weak #1): every round,
+        # both spreads, and who else was on the host — a bench line
+        # captured under load is now self-describing
+        "rounds": {k: v["rounds"] for k, v in meas.items()},
+        "spread": {k: v["spread"] for k, v in meas.items()},
+        "host_load": load_before,
+        "host_load_after": load_after,
+        "idle_host": bool(load_before["idle_host"]
+                          and load_after["idle_host"]),
     }
     out.update(bench_transformer_mfu())
     out.update(bench_kernel_numerics())

@@ -65,11 +65,12 @@ _EXPORTS = {
     "elastic": "shallowspeed_tpu.elastic",
     "metrics": "shallowspeed_tpu.metrics",
     "optim": "shallowspeed_tpu.optim",
+    "telemetry": "shallowspeed_tpu.telemetry",
     "utils": "shallowspeed_tpu.utils",
 }
 
 _MODULE_EXPORTS = {"analysis", "checkpoint", "distributed", "elastic",
-                   "metrics", "optim", "utils"}
+                   "metrics", "optim", "telemetry", "utils"}
 
 __all__ = sorted(_EXPORTS) + ["functional"]
 

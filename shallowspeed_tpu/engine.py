@@ -128,13 +128,19 @@ class FusedDPEngine:
     def train_batch(self, batch_id: int, datasets):
         """datasets: dp per-rank Dataset shards; assembles the
         (dp, n_mu, mubs, d) stacks and runs the fused step."""
+        from shallowspeed_tpu.telemetry import tracer
+
         stacks = [ds.load_mubatch_stack(batch_id) for ds in datasets]
         xs = np.stack([s[0] for s in stacks])
         ys = np.stack([s[1] for s in stacks])
-        xs = jax.device_put(xs, self.shard4)
-        ys = jax.device_put(ys, self.shard4)
-        self.params, self.opt_state = self._step(
-            self.params, self.opt_state, xs, ys)
+        with tracer().span("step", batch=batch_id) as sp:
+            xs = jax.device_put(xs, self.shard4)
+            ys = jax.device_put(ys, self.shard4)
+            if self._telemetry_eps is None and tracer().level != "off":
+                self._record_entrypoints(xs, ys)
+            self.params, self.opt_state = self._step(
+                self.params, self.opt_state, xs, ys)
+            sp.fence(self.params[0]["b"])
 
     def infer(self, x: np.ndarray) -> jax.Array:
         """Forward on a (rows, 784) batch sharded over dp (rows % dp == 0)."""
@@ -160,11 +166,32 @@ class FusedDPEngine:
         """One dispatch for a full n_epochs training run over pre-staged
         device data (same epoch data each epoch, as the reference has no
         shuffling — `dataset.py:66-80` indexes deterministically)."""
+        from shallowspeed_tpu.telemetry import tracer
+
         xs, ys = staged
         run = self._run_cache.get(n_epochs)
         if run is None:
             run = self._run_cache[n_epochs] = self._make_run(n_epochs)
-        self.params, self.opt_state = run(self.params, self.opt_state, xs, ys)
+        with tracer().span("run", n_epochs=n_epochs) as sp:
+            self.params, self.opt_state = run(self.params,
+                                              self.opt_state, xs, ys)
+            sp.fence(self.params[0]["b"])
+
+    # ----------------------------------------------- telemetry surface
+
+    _telemetry_eps = None
+
+    def _record_entrypoints(self, xs, ys):
+        from shallowspeed_tpu.telemetry.report import (
+            record_engine_entrypoints)
+
+        self._telemetry_eps = record_engine_entrypoints(
+            self, xs, ys, step_arg=False)
+
+    def telemetry_entrypoints(self) -> list:
+        """(name, fn, SDS args) for telemetry's static accounting
+        (report.py); empty before the first traced `train_batch`."""
+        return list(self._telemetry_eps or ())
 
     # -------------------------------------------------- checkpoint interface
 

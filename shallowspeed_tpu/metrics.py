@@ -4,7 +4,11 @@ The reference's observability is bare `print` (SURVEY §5: epoch/time/accuracy
 lines, `/root/reference/train.py:135-137,150-152`). This keeps that console
 surface (via `utils.rprint`) and adds a structured JSONL sink so runs are
 machine-comparable: one line per epoch with wall-clock, accuracy, and
-throughput.
+throughput. With a `telemetry.RunTelemetry` attached, `StepRates` lines
+additionally carry the runtime telemetry fields (live-vs-static HBM,
+per-axis collective bytes + implied GB/s, recompile counter, pipeline
+bubble fractions) — schema in `telemetry/schema.py`, which also gates
+committed `docs_runs/*.jsonl` artifacts at pre-commit time.
 """
 
 from __future__ import annotations
@@ -63,7 +67,8 @@ class StepRates:
     cumulative stays alongside as the whole-run summary.
     """
 
-    def __init__(self, tokens_per_step: float, clock=time.time):
+    def __init__(self, tokens_per_step: float, clock=time.time,
+                 telemetry=None):
         self.tokens_per_step = float(tokens_per_step)
         self._clock = clock
         self._t0 = clock()
@@ -71,6 +76,11 @@ class StepRates:
         self._win_t = self._t0    # wall clock at the last log point
         self._win_pause = 0.0     # excluded seconds at the last log point
         self._steps = 0           # steps accounted across all windows
+        # optional telemetry.RunTelemetry: when set, every log_point
+        # line additionally carries the run's telemetry fields (HBM
+        # live/static, per-axis collective bytes + implied GB/s over
+        # the closed window, recompile counter, bubble fractions)
+        self.telemetry = telemetry
 
     def pause(self, seconds: float) -> None:
         """Exclude `seconds` of non-training wall time (val eval, ckpt
@@ -80,7 +90,8 @@ class StepRates:
 
     def log_point(self, steps_since_last: int) -> dict:
         """Close the current window (`steps_since_last` training steps
-        since the previous log point) and return both rates."""
+        since the previous log point) and return both rates (plus the
+        telemetry fields when a RunTelemetry is attached)."""
         now = self._clock()
         self._steps += int(steps_since_last)
         win_secs = max(now - self._win_t
@@ -89,4 +100,13 @@ class StepRates:
         win = self.tokens_per_step * steps_since_last / win_secs
         cum = self.tokens_per_step * self._steps / cum_secs
         self._win_t, self._win_pause = now, self._pause
-        return {"tokens_per_sec": win, "tokens_per_sec_cum": cum}
+        out = {"tokens_per_sec": win, "tokens_per_sec_cum": cum}
+        if self.telemetry is not None:
+            out.update(self.telemetry.step_fields(
+                window_secs=win_secs,
+                steps_in_window=int(steps_since_last)))
+            # telemetry's own cost (the one-time static jaxpr trace can
+            # be seconds on a big step) must not depress the NEXT
+            # window's rate — book it as excluded pause time
+            self._pause += self._clock() - now
+        return out

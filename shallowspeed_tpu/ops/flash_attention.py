@@ -485,12 +485,21 @@ def _sds(shape, dtype, like):
 
 
 def _chunk_fwd(q3, k3, v3, rel, *, causal, window, bq, bk, nqb_chunk,
-               interpret):
+               interpret, out_dtype=None):
+    """One chunk's flash forward. `out_dtype` overrides the o output's
+    dtype (default: q3's): the RING path passes f32 so each chunk's
+    normalized output reaches the log-sum-exp merge unrounded — with a
+    bf16 chunk output every ring hop quantized its partial to bf16
+    before the merge, compounding ~sqrt(n_chunks) x the single-rounding
+    bf16 floor (the BENCH_r05 `ring_chunk` 2.3x-above-floor finding,
+    VERDICT r5 weak #2; BASELINE.md 'ring-chunk numerics envelope').
+    The kernel accumulator is f32 either way — this only widens what
+    leaves the kernel; single-chunk callers keep the narrow output."""
     bh, rows, d = q3.shape
     tk = k3.shape[1]
     scale = 1.0 / float(np.sqrt(d))
     out_shape = [
-        _sds((bh, rows, d), q3.dtype, q3),
+        _sds((bh, rows, d), out_dtype or q3.dtype, q3),
         _sds((bh, rows, _LANES), jnp.float32, q3),
     ]
     if tk * d * q3.dtype.itemsize <= _RESIDENT_BYTES:
@@ -793,8 +802,12 @@ def _ring_fwd(q, k, v, axis_name, causal, window):
     window = int(window)
     q3 = _fold_q(q, kvh)
     k3, v3 = _to_bhsd(k), _to_bhsd(v)
+    # f32 chunk outputs: the lse-merge carry is f32, so a bf16 chunk
+    # output would round every partial once per ring hop before
+    # merging (see _chunk_fwd's out_dtype note)
     kw = dict(causal=causal, window=window, bq=bq, bk=bk,
-              nqb_chunk=nqb_chunk, interpret=interpret)
+              nqb_chunk=nqb_chunk, interpret=interpret,
+              out_dtype=jnp.float32)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     # Ring step i: device idx holds the K/V block of device (idx - i)

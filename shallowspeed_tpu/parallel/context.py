@@ -380,19 +380,53 @@ class ContextParallelEngine:
     def train_batch_async(self, tokens, targets) -> jax.Array:
         """One optimizer step; loss as a lazy device scalar (no host sync —
         `float()` it only at log points; see `data/prefetch.py`)."""
+        from shallowspeed_tpu.telemetry import tracer
+
         step = np.uint32(self._step_count)
         self._step_count += 1
-        if self._step_fn is None:  # ZeRO-1/2: grad program + sharded update
-            loss, grads = self._loss_grads_fn(
-                self.params, self._place(tokens), self._place(targets),
-                step)
-            self.params, self.opt_state = self._update_fn(
-                self.params, grads, self.opt_state)
-            return loss
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state,
-            self._place(tokens), self._place(targets), step)
+        with tracer().span("step", step=int(step)) as sp:
+            if self._step_fn is None:  # ZeRO-1/2: grads + sharded update
+                with tracer().span("grads", step=int(step)) as g:
+                    loss, grads = self._loss_grads_fn(
+                        self.params, self._place(tokens),
+                        self._place(targets), step)
+                    g.fence(loss)
+                with tracer().span("update", step=int(step)) as u:
+                    if self._telemetry_eps is None \
+                            and tracer().level != "off":
+                        self._record_entrypoints(tokens, targets,
+                                                 grads=grads)
+                    self.params, self.opt_state = self._update_fn(
+                        self.params, grads, self.opt_state)
+                    u.fence(self.opt_state)
+            else:
+                self.params, self.opt_state, loss = self._step_fn(
+                    self.params, self.opt_state,
+                    self._place(tokens), self._place(targets), step)
+                if self._telemetry_eps is None \
+                        and tracer().level != "off":
+                    self._record_entrypoints(tokens, targets)
+            sp.fence(loss)
         return loss
+
+    # ----------------------------------------------- telemetry surface
+
+    _telemetry_eps = None
+
+    def _record_entrypoints(self, tokens, targets, grads=None):
+        """One-time (first traced step) skeleton capture for
+        telemetry's static accounting (report.py resolves the
+        conventional entrypoint attributes)."""
+        from shallowspeed_tpu.telemetry.report import (
+            record_engine_entrypoints)
+
+        self._telemetry_eps = record_engine_entrypoints(
+            self, tokens, targets, grads=grads)
+
+    def telemetry_entrypoints(self) -> list:
+        """(name, fn, SDS args) per compiled entrypoint, step first
+        (report.py convention); empty before the first traced step."""
+        return list(self._telemetry_eps or ())
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         """One optimizer step on a (B, T) int token batch; returns the loss."""

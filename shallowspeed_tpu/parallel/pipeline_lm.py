@@ -1664,19 +1664,74 @@ class PipelineLMEngine:
     # ---------------------------------------------------------------- steps
 
     def train_batch_async(self, tokens, targets) -> jax.Array:
+        from shallowspeed_tpu.telemetry import tracer
+
         step = np.uint32(self._step_count)
         self._step_count += 1
-        if self._step_fn is None:  # zero1: grad program + GSPMD update
-            loss, grads = self._loss_grads_fn(
-                self.params, self.place(tokens), self.place(targets),
-                step)
-            self.params, self.opt_state = self._update_fn(
-                self.params, grads, self.opt_state)
-            return loss
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state, self.place(tokens),
-            self.place(targets), step)
+        tok, tgt = self.place(tokens), self.place(targets)
+        with tracer().span("step", step=int(step),
+                           schedule=self.schedule) as sp:
+            if self._step_fn is None:  # zero1: grads + GSPMD update
+                with tracer().span("grads", step=int(step)) as g:
+                    loss, grads = self._loss_grads_fn(
+                        self.params, tok, tgt, step)
+                    g.fence(loss)
+                with tracer().span("update", step=int(step)) as u:
+                    if self._telemetry_eps is None \
+                            and tracer().level != "off":
+                        self._record_entrypoints(tok, tgt, grads=grads)
+                    self.params, self.opt_state = self._update_fn(
+                        self.params, grads, self.opt_state)
+                    u.fence(self.opt_state)
+            else:
+                self.params, self.opt_state, loss = self._step_fn(
+                    self.params, self.opt_state, tok, tgt, step)
+                if self._telemetry_eps is None \
+                        and tracer().level != "off":
+                    self._record_entrypoints(tok, tgt)
+            sp.fence(loss)
         return loss
+
+    # ----------------------------------------------- telemetry surface
+
+    _telemetry_eps = None
+
+    def _record_entrypoints(self, tok, tgt, grads=None):
+        """One-time (first traced step) skeleton capture for
+        telemetry's static accounting (report.py resolves the
+        conventional entrypoint attributes); `tok`/`tgt` are already
+        microbatch-split and placed, matching what the compiled step
+        consumes."""
+        from shallowspeed_tpu.telemetry.report import (
+            record_engine_entrypoints)
+
+        self._telemetry_eps = record_engine_entrypoints(
+            self, tok, tgt, grads=grads)
+
+    def telemetry_entrypoints(self) -> list:
+        """(name, fn, SDS args) per compiled entrypoint, step first
+        (report.py convention); empty before the first traced step."""
+        return list(self._telemetry_eps or ())
+
+    def schedule_info(self) -> dict:
+        """What `telemetry.bubble.static_bubble` needs to price this
+        engine's schedule (the executed tables' identity)."""
+        return {"schedule": self.schedule, "n_mu": self.n_mu,
+                "pp": self.pp, "vpp": self.vpp}
+
+    def make_calibration_twin(self) -> "PipelineLMEngine":
+        """A fresh engine at 2x microbatches for the two-point bubble
+        measurement (`telemetry.bubble.calibrate_compiled`): fed a
+        row-doubled batch it keeps the per-microbatch shape — and hence
+        the per-round cost — identical, so the step-time difference is
+        exactly n_mu rounds of pipeline work. Fresh params/opt state;
+        never touches this engine's training state."""
+        return PipelineLMEngine(
+            self.cfg, self.optimizer, self.mesh,
+            n_mubatches=2 * self.n_mu, seed=self._seed,
+            schedule=self.schedule, attn=self.attn,
+            virtual_pp=self.vpp, zero1=self.zero1, zero2=self.zero2,
+            fsdp=self.fsdp)
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         return float(self.train_batch_async(tokens, targets))

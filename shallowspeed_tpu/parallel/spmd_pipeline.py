@@ -370,9 +370,16 @@ class SPMDPipelineEngine:
                 jax.device_put(ys, shard))
 
     def train_batch(self, batch_id, datasets):
+        from shallowspeed_tpu.telemetry import tracer
+
         xs, ys = self.stage_batch(datasets, batch_id)
-        self.params, self.opt_state = self._step_fn(
-            self.params, self.opt_state, xs, ys)
+        with tracer().span("step", batch=batch_id,
+                           schedule="gpipe") as sp:
+            if self._telemetry_eps is None and tracer().level != "off":
+                self._record_entrypoints(xs, ys)
+            self.params, self.opt_state = self._step_fn(
+                self.params, self.opt_state, xs, ys)
+            sp.fence(self.params["b"])
 
     def stage_epoch(self, datasets, n_batches=None):
         from shallowspeed_tpu.data.dataset import stack_epoch
@@ -383,9 +390,35 @@ class SPMDPipelineEngine:
                 jax.device_put(ys, shard))
 
     def train_epoch(self, staged):
+        from shallowspeed_tpu.telemetry import tracer
+
         xs, ys = staged
-        self.params, self.opt_state = self._epoch_fn(
-            self.params, self.opt_state, xs, ys)
+        with tracer().span("epoch") as sp:
+            self.params, self.opt_state = self._epoch_fn(
+                self.params, self.opt_state, xs, ys)
+            sp.fence(self.params["b"])
+
+    # ----------------------------------------------- telemetry surface
+
+    _telemetry_eps = None
+
+    def _record_entrypoints(self, xs, ys):
+        from shallowspeed_tpu.telemetry.report import (
+            record_engine_entrypoints)
+
+        self._telemetry_eps = record_engine_entrypoints(
+            self, xs, ys, step_arg=False)
+
+    def telemetry_entrypoints(self) -> list:
+        """(name, fn, SDS args) for telemetry's static accounting
+        (report.py); empty before the first traced `train_batch`."""
+        return list(self._telemetry_eps or ())
+
+    def schedule_info(self) -> dict:
+        """Executed-schedule identity for bubble accounting: this
+        engine IS the compiled GPipe tick program."""
+        return {"schedule": "gpipe", "n_mu": self.n_mu, "pp": self.pp,
+                "vpp": 1}
 
     def infer(self, x: np.ndarray) -> jax.Array:
         """Forward a (rows, in_dim) batch; returns (rows, out_dim) probs."""

@@ -115,6 +115,20 @@ class StageRuntime:
             return dx, new_acc
 
         @partial(jax.jit)
+        @partial(shard_map, mesh=mesh, in_specs=(P(),),
+                 out_specs=P("dp"))
+        def _zeros_acc(params):
+            # the accumulator must be born with the SAME sharding the
+            # steady-state path produces (a shard_map output under
+            # out_specs P('dp')): a plain device_put(zeros, row) carries
+            # a differently-normalized sharding in the jit cache key, so
+            # the second BackwardGradAcc of every batch silently
+            # recompiled each stage's _bwd_acc — caught by telemetry's
+            # recompile counter (PR 2), invisible before it
+            return tree_map(
+                lambda p: jnp.zeros((1,) + p.shape, p.dtype), params)
+
+        @partial(jax.jit)
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), P("dp"), P("dp"), P("dp")),
                  out_specs=(P("dp"), P()))
@@ -139,16 +153,16 @@ class StageRuntime:
         self._infer = _infer
         self._bwd_acc = _bwd_acc
         self._bwd_allreduce = _bwd_allreduce
+        self._zeros_acc = _zeros_acc
         self._opt = jax.jit(_opt) if optimizer is not None else None
 
     # ------------------------------------------------------------ state ops
 
     def zero_grad(self):
-        """Fresh (dp, ...) zero accumulator (`pipe.py:411-412`)."""
-        self.grad_acc = jax.device_put(
-            tree_map(
-                lambda p: jnp.zeros((self.dp,) + p.shape, p.dtype), self.params),
-            self.row)
+        """Fresh (dp, ...) zero accumulator (`pipe.py:411-412`), built
+        through the compiled producer so its sharding matches the
+        steady-state `_bwd_acc` output (see `_zeros_acc`)."""
+        self.grad_acc = self._zeros_acc(self.params)
         self.reduced_grads = None
 
     def forward(self, x, mubatch_id: int, training: bool = True):
@@ -196,6 +210,9 @@ class PipelineExecutor:
             StageRuntime(stage, mesh.devices[:, s], optimizer)
             for s, stage in enumerate(stages)]
         self._infer_outputs: list = []
+        # measured comm accounting (telemetry): device-to-device hop
+        # bytes (pp) and per-device dp-psum payload bytes, cumulative
+        self.comm_bytes: dict[str, int] = {}
 
     @property
     def last(self) -> StageRuntime:
@@ -219,6 +236,8 @@ class PipelineExecutor:
         """Run one batch. `schedules`: one Schedule per stage. `datasets`:
         list of dp per-rank Dataset shards (reference loads one shard per DP
         rank, `train.py:113-119`)."""
+        from shallowspeed_tpu.telemetry import tracer
+
         progs = [list(_flatten(s.steps())) for s in schedules]
         pcs = [0] * self.pp
         self._infer_outputs = []
@@ -230,30 +249,40 @@ class PipelineExecutor:
 
         total = sum(len(p) for p in progs)
         done = 0
-        while done < total:
-            progress = False
-            for s in range(self.pp):
-                rt = self.runtimes[s]
-                while pcs[s] < len(progs[s]):
-                    cmd = progs[s][pcs[s]]
-                    if isinstance(cmd, RecvActivations) and not chan(s - 1, s):
-                        break
-                    if isinstance(cmd, RecvOutputGrad) and not chan(s + 1, s):
-                        break
-                    self._dispatch(cmd, rt, s, batch_id, datasets, chan,
-                                   training)
-                    pcs[s] += 1
-                    done += 1
-                    progress = True
-            if not progress:
-                raise RuntimeError(f"pipeline deadlock at pcs={pcs}")
+        with tracer().span("batch", batch=batch_id,
+                           training=training) as sp:
+            while done < total:
+                progress = False
+                for s in range(self.pp):
+                    rt = self.runtimes[s]
+                    while pcs[s] < len(progs[s]):
+                        cmd = progs[s][pcs[s]]
+                        if isinstance(cmd, RecvActivations) \
+                                and not chan(s - 1, s):
+                            break
+                        if isinstance(cmd, RecvOutputGrad) \
+                                and not chan(s + 1, s):
+                            break
+                        self._dispatch(cmd, rt, s, batch_id, datasets,
+                                       chan, training)
+                        pcs[s] += 1
+                        done += 1
+                        progress = True
+                if not progress:
+                    raise RuntimeError(f"pipeline deadlock at pcs={pcs}")
+            sp.fence(*[rt.params[0]["b"] for rt in self.runtimes])
 
     def _dispatch(self, cmd, rt: StageRuntime, s: int, batch_id, datasets,
                   chan, training):
+        from shallowspeed_tpu.telemetry import tracer
+
+        tr = tracer()
         if isinstance(cmd, ZeroGrad):
             rt.zero_grad()
         elif isinstance(cmd, OptimizerStep):
-            rt.optimizer_step()
+            with tr.span("OptimizerStep", stage=s, batch=batch_id) as sp:
+                rt.optimizer_step()
+                sp.fence(rt.params[0]["b"])
         elif isinstance(cmd, LoadMuBatchInput):
             data = self._stacked(datasets, batch_id, cmd.mubatch_id, False)
             rt.input_buffers[cmd.buffer_id] = jax.device_put(data, rt.row)
@@ -261,31 +290,84 @@ class PipelineExecutor:
             data = self._stacked(datasets, batch_id, cmd.mubatch_id, True)
             rt.output_buffers[cmd.buffer_id] = jax.device_put(data, rt.row)
         elif isinstance(cmd, Forward):
-            out = rt.forward(
-                rt.input_buffers[cmd.buffer_id], cmd.mubatch_id, training)
-            rt.output_buffers[cmd.buffer_id] = out
+            # the compute instructions carry (stage, mu, batch) span
+            # attribution: at the `spans` level this IS the executed
+            # schedule trace telemetry.bubble.trace_bubble replays
+            # against verify.py's makespan model
+            with tr.span("Forward", stage=s, mu=cmd.mubatch_id,
+                         batch=batch_id) as sp:
+                out = rt.forward(rt.input_buffers[cmd.buffer_id],
+                                 cmd.mubatch_id, training)
+                rt.output_buffers[cmd.buffer_id] = out
+                sp.fence(out)
             if not training and rt is self.last:
                 self._infer_outputs.append(out)
         elif isinstance(cmd, BackwardGradAcc):
-            rt.input_buffers[cmd.buffer_id] = rt.backward(
-                rt.output_buffers[cmd.buffer_id], cmd.mubatch_id, False)
+            with tr.span("BackwardGradAcc", stage=s, mu=cmd.mubatch_id,
+                         batch=batch_id) as sp:
+                dx = rt.backward(rt.output_buffers[cmd.buffer_id],
+                                 cmd.mubatch_id, False)
+                rt.input_buffers[cmd.buffer_id] = dx
+                sp.fence(dx)
         elif isinstance(cmd, BackwardGradAllReduce):
-            rt.input_buffers[cmd.buffer_id] = rt.backward(
-                rt.output_buffers[cmd.buffer_id], cmd.mubatch_id, True)
+            with tr.span("BackwardGradAllReduce", stage=s,
+                         mu=cmd.mubatch_id, batch=batch_id) as sp:
+                dx = rt.backward(rt.output_buffers[cmd.buffer_id],
+                                 cmd.mubatch_id, True)
+                rt.input_buffers[cmd.buffer_id] = dx
+                sp.fence(dx)
+            # one bucketed dp-psum of the whole grad pytree ran inside:
+            # measured collective accounting (bytes entering the psum)
+            self.comm_bytes["dp_psum"] = self.comm_bytes.get(
+                "dp_psum", 0) + self._grad_bytes(rt)
         elif isinstance(cmd, SendActivations):
             nxt = self.runtimes[s + 1]
-            chan(s, s + 1).append(
-                jax.device_put(rt.output_buffers[cmd.buffer_id], nxt.row))
+            buf = rt.output_buffers[cmd.buffer_id]
+            self.comm_bytes["pp_p2p"] = self.comm_bytes.get(
+                "pp_p2p", 0) + int(buf.nbytes)
+            chan(s, s + 1).append(jax.device_put(buf, nxt.row))
         elif isinstance(cmd, RecvActivations):
             rt.input_buffers[cmd.buffer_id] = chan(s - 1, s).popleft()
         elif isinstance(cmd, SendInputGrad):
             prv = self.runtimes[s - 1]
-            chan(s, s - 1).append(
-                jax.device_put(rt.input_buffers[cmd.buffer_id], prv.row))
+            buf = rt.input_buffers[cmd.buffer_id]
+            self.comm_bytes["pp_p2p"] = self.comm_bytes.get(
+                "pp_p2p", 0) + int(buf.nbytes)
+            chan(s, s - 1).append(jax.device_put(buf, prv.row))
         elif isinstance(cmd, RecvOutputGrad):
             rt.output_buffers[cmd.buffer_id] = chan(s + 1, s).popleft()
         else:
             raise TypeError(f"unknown instruction {cmd!r}")
+
+    @staticmethod
+    def _grad_bytes(rt: StageRuntime) -> int:
+        """Per-device payload of the stage's bucketed dp-psum: the
+        whole params-shaped grad pytree (each device holds one (1, ...)
+        shard of the (dp, ...) accumulator)."""
+        return sum(int(l.nbytes) for layer in rt.params
+                   for l in layer.values())
+
+    # ----------------------------------------------- telemetry surface
+
+    def telemetry_entrypoints(self) -> list:
+        """Per-stage compiled executables (args=None: the VM measures
+        its traffic directly via `comm_bytes` instead of a jaxpr walk,
+        but the recompile counter still reads these caches)."""
+        out = []
+        for s, rt in enumerate(self.runtimes):
+            for name, fn in (("fwd", rt._fwd), ("bwd", rt._bwd_acc),
+                             ("bwd_ar", rt._bwd_allreduce),
+                             ("opt", rt._opt), ("infer", rt._infer)):
+                if fn is not None:
+                    out.append({"name": f"s{s}.{name}", "fn": fn,
+                                "args": None})
+        return out
+
+    def telemetry_traffic(self) -> dict:
+        """MEASURED cumulative comm bytes (pp hop transfers, dp psum
+        payloads) — the interpreted engine's counterpart of the
+        compiled engines' static jaxpr-walk accounting."""
+        return dict(self.comm_bytes)
 
     def allocate_buffers(self, num_buffers: int):
         """Reference allocates numpy comm buffers per schedule

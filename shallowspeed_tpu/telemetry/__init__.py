@@ -1,0 +1,58 @@
+"""Runtime telemetry — structured, attributable time for every engine.
+
+The reference's observability is bare `print` (SURVEY §5,
+`/root/reference/train.py:135-137`); `metrics.py` made runs
+machine-comparable but only at end-of-window granularity. This package
+makes the *inside* of a step visible without xprof:
+
+- `trace`        low-overhead span API (`tracer().span("fwd", step=s)`)
+                 with host wall-clock and, at the `spans` level, device
+                 time via `block_until_ready` fences at phase
+                 boundaries; exports JSONL and Chrome-trace/Perfetto.
+- `bubble`       pipeline bubble accounting: executed schedule traces
+                 (or a two-point step-time calibration for the fused
+                 engines) replayed against `parallel/verify.py`'s
+                 static makespan tables.
+- `collectives`  per-mesh-axis traffic (bytes, call counts) derived
+                 from the same jaxpr walk `analysis/walker.py` does,
+                 joined with measured step time at log points.
+- `memory`       live HBM high-water via `jax.live_arrays()` / device
+                 memory stats, cross-checked against the static
+                 prediction `analysis/rules.py`'s memory rule uses.
+- `report`       `RunTelemetry`: the driver-facing aggregator that
+                 turns all of the above plus retrace/recompile counters
+                 into per-step-line fields.
+- `python -m shallowspeed_tpu.telemetry --validate f.jsonl ...`
+                 schema gate for committed `docs_runs/*.jsonl` traces
+                 (pre-commit hook).
+
+Levels: `off` (no-ops — no fences, no buffers), `steps` (host
+timestamps only; the async dispatch pipeline is preserved), `spans`
+(device fences at span exits: accurate attributed time, serialized
+dispatch — the documented measurement mode).
+"""
+
+# trace has no jax/numpy imports at module level; the heavier modules
+# (collectives/memory/report pull in jax + analysis.walker) resolve
+# lazily so `python -m shallowspeed_tpu.telemetry --validate` — the
+# pre-commit hook — stays a millisecond stdlib-only run.
+from shallowspeed_tpu.telemetry.trace import (  # noqa: F401
+    Tracer, configure, tracer)
+
+_LAZY = {
+    "static_bubble": "bubble", "trace_bubble": "bubble",
+    "two_point_bubble": "bubble",
+    "collective_traffic": "collectives",
+    "device_memory_stats": "memory", "live_hbm_high_water": "memory",
+    "RunTelemetry": "report",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(
+        f"shallowspeed_tpu.telemetry.{mod}"), name)

@@ -1,0 +1,53 @@
+"""CLI: validate committed trace/metrics JSONL against the schema.
+
+    python -m shallowspeed_tpu.telemetry --validate docs_runs/*.jsonl
+    python -m shallowspeed_tpu.telemetry --validate docs_runs/
+
+Exits 1 listing path:line problems; 0 when every line conforms. This
+is the pre-commit gate for `docs_runs/*.jsonl` — the schema module is
+pure stdlib, so the check costs only the package import (~1 s), not a
+trace of anything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m shallowspeed_tpu.telemetry")
+    p.add_argument("--validate", nargs="+", metavar="PATH", required=True,
+                   help="JSONL files (or directories scanned for "
+                        "*.jsonl) to check against the telemetry/"
+                        "metrics schema")
+    args = p.parse_args(argv)
+
+    from shallowspeed_tpu.telemetry.schema import validate_file
+
+    files: list[Path] = []
+    for raw in args.validate:
+        path = Path(raw)
+        if path.is_dir():
+            files.extend(sorted(path.glob("*.jsonl")))
+        else:
+            files.append(path)
+    if not files:
+        print("no .jsonl files to validate")
+        return 0
+    problems = []
+    for f in files:
+        if not f.exists():
+            problems.append(f"{f}: no such file")
+            continue
+        problems.extend(validate_file(f))
+    for prob in problems:
+        print(prob, file=sys.stderr)
+    print(f"validated {len(files)} file(s): "
+          f"{'OK' if not problems else f'{len(problems)} problem(s)'}")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,83 @@
+"""Live HBM accounting — runtime cross-check of the static memory rule.
+
+`analysis/rules.py`'s memory-highwater rule predicts a step's
+live-buffer peak from the jaxpr; this module samples what is ACTUALLY
+resident so every traced run checks the prediction:
+
+- `live_hbm_high_water()`: per-device resident bytes summed over
+  `jax.live_arrays()`'s addressable shards — the steady-state
+  footprint (params, optimizer state, staged batches) between steps.
+- `device_memory_stats()`: the backend allocator's own view
+  (`Device.memory_stats()`: bytes_in_use / peak_bytes_in_use) where
+  the platform provides one (TPU does; CPU returns nothing) — this is
+  the only source that sees TRANSIENTS inside a compiled step.
+
+The cross-check a run report makes: live steady-state bytes must stay
+under the static prediction (which includes the step's transients and
+is deliberately conservative — `walker.peak_bytes` ignores fusion and
+donation). A live sample EXCEEDING static + tolerance means the
+estimator lost track of real buffers — the failure mode the gate
+exists to catch.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def live_hbm_high_water() -> dict:
+    """Resident bytes per device over all live jax.Arrays; returns
+    {"per_device": {dev_str: bytes}, "max_device_bytes", "n_arrays"}.
+    Deleted/donated buffers are excluded by construction (donation
+    makes the input array non-live). Committed multi-device arrays
+    contribute each shard to its own device."""
+    per_dev: dict[str, int] = {}
+    n = 0
+    for arr in jax.live_arrays():
+        try:
+            shards = arr.addressable_shards
+        except Exception:
+            continue
+        n += 1
+        for sh in shards:
+            d = str(sh.device)
+            per_dev[d] = per_dev.get(d, 0) + int(sh.data.nbytes)
+    return {"per_device": per_dev,
+            "max_device_bytes": max(per_dev.values(), default=0),
+            "n_arrays": n}
+
+
+def device_memory_stats() -> dict:
+    """Allocator stats per device where the backend exposes them
+    ({} on CPU). Keys kept verbatim from `Device.memory_stats()`."""
+    out = {}
+    for d in jax.local_devices():
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if st:
+            out[str(d)] = {k: int(v) for k, v in st.items()
+                           if isinstance(v, (int, float))}
+    return out
+
+
+def static_peak_bytes(fn, *args) -> int:
+    """The static live-buffer high-water estimate for one entrypoint —
+    the same number `analysis/rules.py`'s memory rule reports (traced
+    on ShapeDtypeStructs; nothing executes)."""
+    from shallowspeed_tpu.analysis.walker import peak_bytes
+
+    closed = jax.make_jaxpr(fn)(*args)
+    return peak_bytes(closed.jaxpr)
+
+
+def cross_check(live_max: int, static_peak: int,
+                tolerance: float = 1.05) -> dict:
+    """live steady-state vs static prediction: ok iff
+    live <= static * tolerance (static includes in-step transients, so
+    steady-state residency above it means the estimator lost buffers)."""
+    ok = live_max <= static_peak * tolerance
+    return {"live_bytes": int(live_max), "static_bytes": int(static_peak),
+            "ratio": round(live_max / max(static_peak, 1), 4),
+            "within_bound": bool(ok)}

@@ -1,0 +1,251 @@
+"""RunTelemetry — the driver-facing aggregator.
+
+One object per training run. The engines expose
+`telemetry_entrypoints()` (name, jitted fn, ShapeDtypeStruct args —
+recorded at their first real step, so the skeletons match what
+actually runs); RunTelemetry turns that plus the live process state
+into:
+
+- a one-time STATIC report: per-axis collective bytes/calls per step
+  (`collectives.py` jaxpr walk) and the static HBM peak prediction
+  (`memory.static_peak_bytes`, the analysis memory rule's number);
+- per-log-point STEP FIELDS merged into `metrics.StepRates` lines:
+  live HBM high-water + the live-vs-static cross-check, implied
+  collective GB/s over the closed window, and the recompile counter
+  (jit cache sizes beyond the first-step baseline — the class of bug
+  the gspmd `pos_emb` placement drift was, PR 1, now visible on every
+  step line);
+- an end-of-run summary (written into the trace dir next to the spans).
+
+Everything here degrades gracefully: no entrypoints yet -> static
+fields appear at the first log point after a step; an engine without
+`telemetry_entrypoints` -> step fields reduce to HBM + recompiles.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from shallowspeed_tpu.telemetry import collectives, memory
+
+MiB = float(1 << 20)
+
+
+def sds(tree):
+    """Shape/dtype skeleton of a pytree (targets.py's `_sds` contract:
+    safe to trace, can never alias live buffers)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(np.shape(l), np.asarray(l).dtype)
+        if not hasattr(l, "aval") and not hasattr(l, "dtype")
+        else jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+
+
+def step_entrypoints(params, opt_state, tok, tgt, step_fn=None,
+                     grads_fn=None, update_fn=None, grads=None,
+                     eval_fn=None, step_arg: bool = True) -> list:
+    """The engines' shared skeleton capture (one call at their first
+    TRACED step — the call sites gate on the tracer level, so the
+    default `off` path never imports this module): (name, fn, SDS
+    args) per compiled entrypoint, step program first. Pass `step_fn`
+    for fused-step engines, or `grads_fn`+`update_fn`+`grads` for the
+    ZeRO split; `step_arg=False` for the MLP engines whose step fns
+    take no step counter."""
+    import jax
+
+    tok, tgt = sds(tok), sds(tgt)
+    stp = ((jax.ShapeDtypeStruct((), np.uint32),) if step_arg else ())
+    if step_fn is not None:
+        eps = [{"name": "_step", "fn": step_fn,
+                "args": (sds(params), sds(opt_state), tok, tgt, *stp)}]
+    else:
+        eps = [
+            {"name": "_grads", "fn": grads_fn,
+             "args": (sds(params), tok, tgt, *stp)},
+            {"name": "_update", "fn": update_fn,
+             "args": (sds(params), sds(grads), sds(opt_state))},
+        ]
+    if eval_fn is not None:
+        eps.append({"name": "_eval", "fn": eval_fn,
+                    "args": (sds(params), tok, tgt)})
+    return eps
+
+
+def record_engine_entrypoints(engine, tok, tgt, grads=None,
+                              step_arg: bool = True) -> list:
+    """`step_entrypoints` with the engines' conventional attribute
+    names resolved in ONE place (fused step: `_step_fn`/`_step`; ZeRO
+    split: `_grads_fn`/`_loss_grads_fn` + `_update_fn`; optional
+    `_eval_fn`) — every engine's `_record_entrypoints` is a one-line
+    call here, so the entrypoint convention cannot drift per engine."""
+    step_fn = getattr(engine, "_step_fn", getattr(engine, "_step",
+                                                  None))
+    grads_fn = update_fn = None
+    if step_fn is None:
+        grads_fn = (getattr(engine, "_grads_fn", None)
+                    or getattr(engine, "_loss_grads_fn", None))
+        update_fn = engine._update_fn
+    return step_entrypoints(
+        engine.params, engine.opt_state, tok, tgt, step_fn=step_fn,
+        grads_fn=grads_fn, update_fn=update_fn, grads=grads,
+        eval_fn=getattr(engine, "_eval_fn", None), step_arg=step_arg)
+
+
+def compile_counts(entrypoints) -> dict:
+    """name -> live jit-cache size for every entrypoint that exposes
+    one (`fn._cache_size`, the same counter analysis' retrace rule
+    reads)."""
+    out = {}
+    for ep in entrypoints:
+        size = getattr(ep["fn"], "_cache_size", None)
+        if size is not None:
+            try:
+                out[ep["name"]] = int(size())
+            except Exception:
+                pass
+    return out
+
+
+class RunTelemetry:
+    """Aggregates telemetry for one engine over one training run."""
+
+    def __init__(self, engine, tracer=None, check_tolerance: float = 1.05):
+        self.engine = engine
+        self.tracer = tracer
+        self.tol = check_tolerance
+        self._static = None
+        self._bubble: dict = {}
+
+    # -------------------------------------------------------- static
+
+    def _entrypoints(self) -> list:
+        fn = getattr(self.engine, "telemetry_entrypoints", None)
+        if fn is None:
+            return []
+        return fn()
+
+    def static_report(self) -> dict | None:
+        """Computed once, lazily (needs a step to have run so the
+        engines know their batch skeletons). Entrypoints published
+        without args (the VM's per-stage executables) count for the
+        recompile counter but are skipped here — the VM measures its
+        traffic directly (`telemetry_traffic`)."""
+        if self._static is not None:
+            return self._static
+        eps = [ep for ep in self._entrypoints()
+               if ep.get("args") is not None]
+        if not eps:
+            return None
+        rep = {}
+        for ep in eps:
+            try:
+                # ONE make_jaxpr per entrypoint, shared by both
+                # accountings — tracing a big pipeline step costs
+                # seconds and must not run twice
+                import jax
+
+                from shallowspeed_tpu.analysis.walker import peak_bytes
+
+                closed = jax.make_jaxpr(ep["fn"])(*ep["args"])
+                traffic = collectives.traffic_of_jaxpr(closed)
+                peak = peak_bytes(closed.jaxpr)
+            except Exception as e:
+                rep[ep["name"]] = {"error": repr(e)[:200]}
+                continue
+            rep[ep["name"]] = {"collectives": traffic,
+                               "static_peak_bytes": peak}
+        self._static = {"entrypoints": rep,
+                        "step": eps[0]["name"]}  # first = the step fn
+        return self._static
+
+    # ---------------------------------------------------------- steps
+
+    @property
+    def bubble(self) -> dict:
+        """The bubble fields currently attached to step lines."""
+        return dict(self._bubble)
+
+    def set_bubble(self, **fields) -> None:
+        """Attach bubble accounting (static fraction and, when a
+        calibration or an executed trace produced one, the measured
+        fraction) — merged into every subsequent step line."""
+        self._bubble.update(fields)
+
+    def step_fields(self, window_secs: float | None = None,
+                    steps_in_window: int | None = None) -> dict:
+        """The telemetry fields a step line carries."""
+        out: dict = {}
+        counts = compile_counts(self._entrypoints())
+        if counts:
+            # an entrypoint's FIRST executable is the expected compile
+            # (the analysis retrace rule's n_compiles_expected=1);
+            # every executable beyond one is a recompile — the counter
+            # the acceptance gate requires to stay 0 after step 1
+            out["compiles"] = sum(counts.values())
+            out["recompiles"] = sum(max(0, c - 1)
+                                    for c in counts.values())
+        live = memory.live_hbm_high_water()
+        out["hbm_live_mib"] = round(live["max_device_bytes"] / MiB, 2)
+        stats = memory.device_memory_stats()
+        peaks = [v.get("peak_bytes_in_use") for v in stats.values()
+                 if v.get("peak_bytes_in_use")]
+        if peaks:
+            out["hbm_alloc_peak_mib"] = round(max(peaks) / MiB, 2)
+        static = self.static_report()
+        if static is not None:
+            step_ep = static["entrypoints"].get(static["step"], {})
+            peak = step_ep.get("static_peak_bytes")
+            if peak:
+                chk = memory.cross_check(live["max_device_bytes"], peak,
+                                         self.tol)
+                out["hbm_static_mib"] = round(peak / MiB, 2)
+                out["hbm_within_bound"] = chk["within_bound"]
+            traffic = step_ep.get("collectives")
+            if traffic:
+                out["coll_bytes_per_step"] = traffic["total_bytes"]
+                out["coll_bytes_by_axis"] = {
+                    ax: v["bytes"]
+                    for ax, v in traffic["per_axis"].items()}
+                if window_secs and steps_in_window:
+                    gbps = (traffic["total_bytes"] * steps_in_window
+                            / window_secs / 1e9)
+                    out["coll_gbps"] = round(gbps, 6)
+        measured = getattr(self.engine, "telemetry_traffic", None)
+        if measured is not None:
+            out["coll_bytes_measured"] = measured()
+        out.update(self._bubble)
+        return out
+
+    # -------------------------------------------------------- summary
+
+    def run_summary(self) -> dict:
+        """End-of-run record: static report + final live sample +
+        bubble + compile counters (written next to the trace)."""
+        static = self.static_report()
+        live = memory.live_hbm_high_water()
+        counts = compile_counts(self._entrypoints())
+        out = {
+            "engine": type(self.engine).__name__,
+            "static": static,
+            "hbm_live_mib": round(live["max_device_bytes"] / MiB, 2),
+            "compile_counts": counts,
+            "bubble": self._bubble or None,
+        }
+        if static is not None:
+            peak = static["entrypoints"].get(
+                static["step"], {}).get("static_peak_bytes")
+            if peak:
+                out["hbm_check"] = memory.cross_check(
+                    live["max_device_bytes"], peak, self.tol)
+        return out
+
+    def write_summary(self, trace_dir) -> Path:
+        path = Path(trace_dir) / "telemetry.json"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(self.run_summary(), indent=2,
+                                   default=str))
+        return path

@@ -1,0 +1,112 @@
+"""Trace/metrics JSONL schema — the committed-artifact gate.
+
+Two line dialects share `docs_runs/*.jsonl`:
+
+- METRICS lines (`metrics.MetricsLogger`): {"event": <type>, ...} with
+  per-type required fields (a "step" line must carry step/loss/
+  tokens_per_sec — and, when telemetry was on, its telemetry fields
+  must be well-typed).
+- SPAN lines (`telemetry.trace.Tracer`): Chrome-trace-shaped events
+  {"name", "ph": "X"|"i"|"C", "ts"[, "dur"], "args"} in microseconds.
+
+`validate_line` returns a list of problems (empty = valid);
+`validate_file` maps them to line numbers. The pre-commit hook runs
+`python -m shallowspeed_tpu.telemetry --validate <files>` over any
+committed docs_runs JSONL so a snapshot that drifts from the schema
+fails at commit time, not at the next reader.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+_NUM = (int, float)
+
+# metrics dialect: per-event required fields and their types
+_METRIC_EVENTS = {
+    "run_start": {},
+    "epoch": {"epoch": int, "epoch_seconds": _NUM},
+    "final": {"accuracy": _NUM, "total_seconds": _NUM},
+    "step": {"step": int, "loss": _NUM, "tokens_per_sec": _NUM},
+    "val": {"step": int, "val_loss": _NUM},
+    "moe_router": {"step": int, "drop_fraction": _NUM},
+    "bubble": {"bubble_static": _NUM},
+    "telemetry": {},
+}
+
+# telemetry fields a step line MAY carry; when present they must type
+_STEP_TELEMETRY = {
+    "compiles": int, "recompiles": int,
+    "hbm_live_mib": _NUM, "hbm_static_mib": _NUM,
+    "hbm_alloc_peak_mib": _NUM, "hbm_within_bound": bool,
+    "coll_bytes_per_step": int, "coll_bytes_by_axis": dict,
+    "coll_bytes_measured": dict,
+    "coll_gbps": _NUM, "bubble_static": _NUM, "bubble_measured": _NUM,
+}
+
+_SPAN_PH = {"X", "i", "C"}
+
+
+def validate_line(rec: dict) -> list[str]:
+    """Problems with one parsed JSONL record (empty list = valid)."""
+    if not isinstance(rec, dict):
+        return ["line is not a JSON object"]
+    if "event" in rec:
+        return _validate_metric(rec)
+    if "ph" in rec or "name" in rec:
+        return _validate_span(rec)
+    return ["neither a metrics line ('event') nor a span line ('ph')"]
+
+
+def _validate_metric(rec: dict) -> list[str]:
+    probs = []
+    ev = rec["event"]
+    if ev not in _METRIC_EVENTS:
+        return [f"unknown metrics event {ev!r}"]
+    for field, typ in _METRIC_EVENTS[ev].items():
+        if field not in rec:
+            probs.append(f"{ev}: missing field {field!r}")
+        elif not isinstance(rec[field], typ) \
+                or isinstance(rec[field], bool):
+            probs.append(f"{ev}: field {field!r} is "
+                         f"{type(rec[field]).__name__}, want {typ}")
+    if ev == "step":
+        for field, typ in _STEP_TELEMETRY.items():
+            if field in rec and rec[field] is not None \
+                    and not isinstance(rec[field], typ):
+                probs.append(f"step: telemetry field {field!r} is "
+                             f"{type(rec[field]).__name__}")
+    return probs
+
+
+def _validate_span(rec: dict) -> list[str]:
+    probs = []
+    if "name" not in rec or not isinstance(rec["name"], str):
+        probs.append("span: missing/non-string 'name'")
+    ph = rec.get("ph")
+    if ph not in _SPAN_PH:
+        probs.append(f"span: ph {ph!r} not in {sorted(_SPAN_PH)}")
+    if not isinstance(rec.get("ts"), _NUM):
+        probs.append("span: missing/non-numeric 'ts'")
+    if ph == "X" and not isinstance(rec.get("dur"), _NUM):
+        probs.append("span: 'X' event without numeric 'dur'")
+    if "args" in rec and not isinstance(rec["args"], dict):
+        probs.append("span: 'args' is not an object")
+    return probs
+
+
+def validate_file(path) -> list[str]:
+    """All problems in one JSONL file, prefixed path:lineno."""
+    path = Path(path)
+    out = []
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            out.append(f"{path}:{i}: not JSON ({e.msg})")
+            continue
+        out.extend(f"{path}:{i}: {p}" for p in validate_line(rec))
+    return out

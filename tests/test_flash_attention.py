@@ -356,3 +356,64 @@ def test_ring_flash_streaming_chunks(monkeypatch):
         np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
                                    rtol=1e-3, atol=1e-4,
                                    err_msg=f"d{name}")
+
+
+# ------------------------------------------------ ring-chunk envelope
+
+
+def _ring_pair_err(out_dtype):
+    """Relative error of the two-chunk ring composition (`_chunk_fwd` +
+    `_merge_chunks`, second-half queries over an earlier block at
+    rel=t/2 and the own block at rel=0 — exactly what
+    `ring_flash_attention` composes) against the f32 XLA oracle, at
+    bf16 inputs with the given chunk-output dtype. Returns
+    (flash_err, xla_bf16_floor)."""
+    import shallowspeed_tpu.ops.flash_attention as fa
+
+    rng = np.random.default_rng(7)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 256, 4, 32)) * 0.5,
+                           jnp.bfloat16) for _ in range(3))
+    t2 = 128
+    qh = q[:, t2:]
+    (_, _, _, _, kvh, _, bq, bk, nqb) = fa._ring_geometry(qh, k[:, :t2])
+    kw = dict(causal=True, window=0, bq=bq, bk=bk, nqb_chunk=nqb,
+              interpret=True, out_dtype=out_dtype)
+    q3 = fa._fold_q(qh, kvh)
+    o0, l0 = fa._chunk_fwd(q3, fa._to_bhsd(k[:, :t2]),
+                           fa._to_bhsd(v[:, :t2]), t2, **kw)
+    o1, l1 = fa._chunk_fwd(q3, fa._to_bhsd(k[:, t2:]),
+                           fa._to_bhsd(v[:, t2:]), 0, **kw)
+    o, _ = fa._merge_chunks(o0.astype(jnp.float32), l0, o1, l1)
+    got = fa._unfold_q(o.astype(q3.dtype), 2, 4)
+
+    def rel_err(a, b):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        return float(np.abs(a - b).max()) / max(1e-6,
+                                                float(np.abs(b).max()))
+
+    f32 = jnp.float32
+    oracle = attention(q.astype(f32), k.astype(f32), v.astype(f32),
+                       causal=True)[:, t2:]
+    floor = rel_err(attention(q, k, v, causal=True)[:, t2:], oracle)
+    return rel_err(got, oracle), floor
+
+
+def test_ring_chunk_numerics_envelope():
+    """Pin the ring-chunk merge's numerics envelope (VERDICT r5 weak
+    #2): with the f32 chunk carry the two-chunk composition must sit
+    AT the XLA-bf16 rounding floor (<= 1.25x, headroom for interpret-
+    vs-Mosaic drift), where the old bf16 chunk output measured 2.3x
+    above it on-chip (BENCH_r05). The bf16-chunk variant is measured
+    alongside to prove the carry — not some unrelated drift — is what
+    closes the gap. BASELINE.md 'ring-chunk numerics envelope'
+    documents the mechanism; bench.py certifies the same bound on the
+    compiled kernels every bench round."""
+    err_f32, floor = _ring_pair_err(jnp.float32)
+    err_bf16, _ = _ring_pair_err(None)  # old behavior: chunk o in bf16
+    assert err_f32 <= 1.25 * floor, (
+        f"f32-carry ring chunk error {err_f32} above the bf16 floor "
+        f"{floor} — the merge lost its f32 carry")
+    assert err_f32 < err_bf16, (
+        f"f32 carry ({err_f32}) should beat the bf16 chunk output "
+        f"({err_bf16}) — the envelope mechanism changed")

@@ -70,6 +70,21 @@ def parse_args(argv=None):
                         "(shallowspeed_tpu/elastic.py hang detection)")
     p.add_argument("--log-file", type=str, default="",
                    help="append per-epoch JSONL metrics here")
+    p.add_argument("--telemetry", default="off",
+                   choices=["off", "steps", "spans"],
+                   help="runtime telemetry level (shallowspeed_tpu."
+                        "telemetry): steps = host-clock spans + "
+                        "HBM/collective/recompile fields per epoch "
+                        "line; spans = device-fenced per-instruction "
+                        "spans — on the VM engine this records the "
+                        "executed schedule trace and reports the "
+                        "measured pipeline bubble vs verify.py's "
+                        "static prediction (serializes dispatch; a "
+                        "measurement mode)")
+    p.add_argument("--trace-dir", type=str, default="",
+                   help="write spans.jsonl + trace.json (Chrome/"
+                        "Perfetto) + telemetry.json here; implies "
+                        "--telemetry steps when the level is off")
     p.add_argument("--platform", type=str, default=None,
                    choices=["cpu", "tpu"],
                    help="force a JAX platform (this environment pins "
@@ -231,6 +246,20 @@ def train(args) -> float:
         args.log_file, dp=args.dp, pp=args.pp, schedule=args.schedule,
         engine=type(engine).__name__, batch_size=args.batch_size)
 
+    # ---- runtime telemetry (shallowspeed_tpu/telemetry)
+    from shallowspeed_tpu import telemetry as tele
+
+    if args.trace_dir and args.telemetry == "off":
+        args.telemetry = "steps"  # --trace-dir implies tracing
+    tracer = tele.configure(trace_dir=args.trace_dir or None,
+                            level=args.telemetry)
+    telem = (tele.RunTelemetry(engine, tracer)
+             if args.telemetry != "off" else None)
+    if telem is not None and args.pp > 1:
+        telem.set_bubble(bubble_static=tele.static_bubble(
+            args.schedule, args.mubatches,
+            args.pp)["bubble_fraction"])
+
     # Fused engines: stage the epoch's batches on device once (HBM-resident)
     # and run each epoch as a single dispatch.
     staged = (engine.stage_epoch(train_ds, n_batches)
@@ -248,10 +277,17 @@ def train(args) -> float:
             if args.heartbeat_file:
                 Path(args.heartbeat_file).touch()
             t_epoch = time.time()
+            trace_mark = 0
             if staged is not None:
                 engine.train_epoch(staged)
             else:
                 for batch_id in range(n_batches):
+                    if batch_id == n_batches - 1:
+                        # the bubble replay reads ONLY this batch's
+                        # spans: batch ids repeat across epochs (and
+                        # eval reuses them), so a bare batch filter
+                        # would mix epochs into one replay
+                        trace_mark = tracer.event_count
                     engine.train_batch(schedule_cls, args.mubatches, batch_id,
                                        train_ds)
             # JAX dispatch is async: wait for the params update to land so
@@ -259,6 +295,29 @@ def train(args) -> float:
             jax.block_until_ready(engine.params)
             metrics.epoch(epoch, accuracy, n_batches * args.batch_size,
                           time.time() - t_epoch)
+            if telem is not None:
+                # VM at the `spans` level: the per-instruction fenced
+                # spans ARE the executed schedule trace — replay the
+                # last batch's ops against the dataflow structure and
+                # report the measured bubble vs the static prediction
+                if (args.telemetry == "spans" and staged is None
+                        and args.pp > 1):
+                    from shallowspeed_tpu.telemetry import bubble as _b
+
+                    ops = _b.span_replay_ops(
+                        tracer.events_since(trace_mark),
+                        batch=n_batches - 1)
+                    if ops:
+                        rep = _b.replay_trace(ops, args.pp)
+                        telem.set_bubble(
+                            bubble_measured=rep["bubble_fraction"])
+                tf = telem.step_fields()
+                metrics.log(event="telemetry", epoch=epoch, **tf)
+                if "bubble_measured" in tf:
+                    rprint(f"  telemetry: bubble measured "
+                           f"{tf['bubble_measured']:.1%} vs static "
+                           f"{tf.get('bubble_static', 0.0):.1%}  "
+                           f"hbm {tf.get('hbm_live_mib', 0):,.0f} MiB")
             if args.save_dir:
                 checkpoint.save(args.save_dir, engine, epoch)
 
@@ -266,6 +325,11 @@ def train(args) -> float:
     rprint(f"Epoch: {args.epochs}, Time Spent: {time.time() - start:.2f}s, "
            f"Accuracy: {accuracy * 100:.2f}%")
     metrics.final(accuracy, time.time() - start)
+    if telem is not None:
+        tracer.close()  # flush spans.jsonl, write trace.json
+        if args.trace_dir:
+            path = telem.write_summary(args.trace_dir)
+            rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
 
     # Sanity check: DP replicas hold bit-identical weights (reference
     # `train.py:154-155`, `utils.py:27-31`).

@@ -256,6 +256,20 @@ def parse_args(argv=None):
     p.add_argument("--log-file", type=str, default="")
     p.add_argument("--profile-dir", type=str, default="",
                    help="write a jax.profiler trace of the training loop")
+    p.add_argument("--telemetry", default="off",
+                   choices=["off", "steps", "spans"],
+                   help="runtime telemetry level: steps = host-clock "
+                        "spans + per-step-line HBM/collective/recompile "
+                        "fields (async dispatch preserved); spans = "
+                        "device-fenced phase spans + measured pipeline "
+                        "bubble (accurate attributed time; serializes "
+                        "dispatch — a measurement mode, not a "
+                        "throughput mode)")
+    p.add_argument("--trace-dir", type=str, default="",
+                   help="write the telemetry trace here: spans.jsonl "
+                        "(streamed), trace.json (Chrome/Perfetto), "
+                        "telemetry.json (run summary). Implies "
+                        "--telemetry steps when the level is off")
     p.add_argument("--val-every", type=int, default=0,
                    help="every N steps evaluate held-out loss/perplexity "
                         "(--text: last 10%% of the file; synthetic: a "
@@ -673,6 +687,25 @@ def train(args) -> float:
     metrics = MetricsLogger(args.log_file, dp=args.dp, sp=args.sp,
                             seq_len=args.seq_len, d_model=args.d_model,
                             n_layers=args.n_layers)
+
+    # ---- runtime telemetry (shallowspeed_tpu/telemetry): span tracing,
+    # HBM/collective/recompile step-line fields, bubble accounting
+    from shallowspeed_tpu import telemetry as tele
+
+    if args.trace_dir and args.telemetry == "off":
+        args.telemetry = "steps"  # --trace-dir implies tracing
+    tracer = tele.configure(trace_dir=args.trace_dir or None,
+                            level=args.telemetry)
+    telem = (tele.RunTelemetry(engine, tracer)
+             if args.telemetry != "off" else None)
+    if telem is not None and hasattr(engine, "schedule_info"):
+        # pipeline engines: the verified schedule's static bubble rides
+        # on every step line from the start; the measured fraction
+        # (two-point calibration) joins at the first spans-level log
+        si = engine.schedule_info()
+        telem.set_bubble(bubble_static=tele.static_bubble(
+            si["schedule"], si["n_mu"], si["pp"],
+            si["vpp"])["bubble_fraction"])
     saver = checkpoint.AsyncSaver() if args.async_save else None
 
     def save_ckpt(ckpt_dir, step):
@@ -772,8 +805,9 @@ def train(args) -> float:
     # window + cumulative tok/s with val/save time excluded from both;
     # the WINDOW rate is what step lines and step events report first
     # (the cumulative average buries the sustained rate under compile
-    # time — round-4 endurance lesson)
-    rates = StepRates(args.batch_size * args.seq_len)
+    # time — round-4 endurance lesson). With telemetry on, every
+    # log_point line additionally carries the telemetry fields.
+    rates = StepRates(args.batch_size * args.seq_len, telemetry=telem)
     last_logged = start_step - 1
     loss = float("nan")
     from shallowspeed_tpu.data.prefetch import prefetch_to_device, sync_every
@@ -846,6 +880,11 @@ def train(args) -> float:
                                f"({perf['mfu'] * 100:.1f}% MFU)")
                     rprint(f"step {step:5d}  loss {loss:.4f}  "
                            f"tok/s {r['tokens_per_sec']:,.0f}{mfu_txt}")
+                    # telemetry fields ride the same step line (HBM,
+                    # collective bytes/GB/s, recompiles, bubble)
+                    tfields = {k: v for k, v in r.items()
+                               if k not in ("tokens_per_sec",
+                                            "tokens_per_sec_cum")}
                     metrics.log(event="step", step=step,
                                 loss=round(loss, 6),
                                 tokens_per_sec=round(
@@ -857,7 +896,65 @@ def train(args) -> float:
                                     r["tokens_per_sec_cum"], 1),
                                 tflops_cum=round(cum["tflops"], 2),
                                 mfu_cum=(None if cum["mfu"] is None
-                                         else round(cum["mfu"], 4)))
+                                         else round(cum["mfu"], 4)),
+                                **tfields)
+                    if telem is not None:
+                        parts = []
+                        if "bubble_measured" in tfields:
+                            parts.append(
+                                f"bubble {tfields['bubble_measured']:.1%}"
+                                f" (static "
+                                f"{tfields['bubble_static']:.1%})")
+                        elif "bubble_static" in tfields:
+                            parts.append(f"bubble static "
+                                         f"{tfields['bubble_static']:.1%}")
+                        if "coll_bytes_per_step" in tfields:
+                            mib = tfields["coll_bytes_per_step"] / 2**20
+                            parts.append(f"coll {mib:,.1f} MiB/step")
+                        if "hbm_live_mib" in tfields:
+                            parts.append(
+                                f"hbm {tfields['hbm_live_mib']:,.0f}"
+                                + (f"/{tfields['hbm_static_mib']:,.0f}"
+                                   f" MiB" if "hbm_static_mib" in
+                                   tfields else " MiB"))
+                        if tfields.get("recompiles"):
+                            parts.append(
+                                f"RECOMPILES {tfields['recompiles']}")
+                        if parts:
+                            rprint("             " + "  ".join(parts))
+                    if (telem is not None
+                            and args.telemetry == "spans"
+                            and args.pp > 1
+                            and hasattr(engine, "schedule_info")
+                            and "bubble_measured" not in telem.bubble):
+                        # two-point bubble calibration: one extra
+                        # engine compile, training state untouched;
+                        # excluded from the throughput windows
+                        from shallowspeed_tpu.telemetry import (
+                            bubble as _bubble)
+
+                        tc = time.time()
+                        htok, htgt = make_batch(args, vocab, step,
+                                                text_data)
+                        cal = _bubble.calibrate_compiled(
+                            engine, tracer, local_rows(htok),
+                            local_rows(htgt))
+                        rates.pause(time.time() - tc)
+                        if cal is not None:
+                            telem.set_bubble(
+                                bubble_static=cal["bubble_static"],
+                                bubble_measured=cal["bubble_measured"])
+                            metrics.log(event="bubble", step=step,
+                                        **cal["bubble_detail"],
+                                        bubble_static=cal[
+                                            "bubble_static"],
+                                        bubble_measured=cal[
+                                            "bubble_measured"])
+                            rprint(f"             bubble measured "
+                                   f"{cal['bubble_measured']:.1%} vs "
+                                   f"static {cal['bubble_static']:.1%} "
+                                   f"({si['schedule']}, n_mu="
+                                   f"{si['n_mu']}, pp={si['pp']})")
                     if args.experts and hasattr(engine, "router_stats"):
                         # routing observability: the capacity drop is
                         # silent in the loss (ops/moe.py), so surface it
@@ -895,6 +992,11 @@ def train(args) -> float:
         # device by a blocked producer thread
         if hasattr(placed, "close"):
             placed.close()
+        if telem is not None:
+            tracer.close()  # flush spans.jsonl, write trace.json
+            if args.trace_dir:
+                path = telem.write_summary(args.trace_dir)
+                rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
         if saver is not None:
             if sys.exc_info()[0] is None:
                 # wait() is the COLLECTIVE failure-exchange point: if
