@@ -49,6 +49,37 @@ import time
 from dataclasses import dataclass, field
 
 
+# --------------------------------------------------- heartbeat status
+#
+# The heartbeat file is liveness AND health (round 7): its mtime is the
+# liveness clock (a stale file means a hung step loop, as before), and
+# its CONTENT is the health verdict — "ok", or "dead <reason>" when the
+# driver's HealthMonitor (telemetry/health.py) concludes the run is
+# numerically dead (sustained non-finite gradients, loss divergence).
+# A dead status makes the supervisor kill and restart the run from the
+# last good checkpoint IMMEDIATELY — a numerically-dead run beats
+# steadily (the loop is not hung), so the hang timeout would never
+# fire, and every further step is wasted work. Plain `touch`ed (empty)
+# heartbeat files remain valid "ok" beats.
+
+
+def write_heartbeat(path, status: str = "ok") -> None:
+    """One beat: refresh the mtime and record the health status."""
+    with open(path, "w") as f:
+        f.write(status)
+
+
+def read_heartbeat_status(path) -> str:
+    """The file's health status ("ok" for empty/missing/unreadable —
+    liveness is the mtime's job, not this one's)."""
+    try:
+        with open(path) as f:
+            status = f.read(256).strip()
+    except OSError:
+        return "ok"
+    return status or "ok"
+
+
 @dataclass
 class RestartPolicy:
     """Budgeted restarts with exponential backoff.
@@ -119,11 +150,15 @@ class Supervisor:
         reports exit code -9."""
         t0 = time.monotonic()
         if self.heartbeat_file:
-            # a fresh child gets a fresh liveness clock
+            # a fresh child gets a fresh liveness clock AND a fresh
+            # health status — a leftover 'dead ...' from the previous
+            # child would otherwise be re-read ~1 poll after spawn
+            # (long before the restarted child's first log-point beat)
+            # and kill every restart until the budget is exhausted
             try:
-                os.utime(self.heartbeat_file, None)
+                write_heartbeat(self.heartbeat_file, "ok")
             except OSError:
-                open(self.heartbeat_file, "w").close()
+                pass
         child = subprocess.Popen(self.argv)
         # staleness floor: if the heartbeat file disappears mid-run
         # (deleted, tmpfs wipe), measure staleness from the last KNOWN
@@ -134,6 +169,20 @@ class Supervisor:
             code = child.poll()
             if code is not None:
                 return code, time.monotonic() - t0
+            if self.heartbeat_file:
+                status = read_heartbeat_status(self.heartbeat_file)
+                if status.startswith("dead"):
+                    # numerically dead, not hung: the loop still beats,
+                    # so the hang timeout (if any) would never fire —
+                    # restart from the last good checkpoint now. This
+                    # check needs only a heartbeat file, NOT a hang
+                    # timeout.
+                    self.log(f"[elastic] health verdict {status!r} — "
+                             f"killing child {child.pid} for a "
+                             f"checkpoint restart")
+                    child.send_signal(signal.SIGKILL)
+                    child.wait()
+                    return -9, time.monotonic() - t0
             if self.hang_timeout is not None:
                 try:
                     hb_seen = max(hb_seen,
@@ -274,10 +323,13 @@ class GangSupervisor(Supervisor):
             for i in range(self.n):
                 argv = list(self.argv)
                 if self.heartbeat_files:
+                    # fresh clock AND fresh status per attempt (see
+                    # Supervisor._run_once: a leftover 'dead' would
+                    # kill every restarted gang within one poll)
                     try:
-                        os.utime(self.heartbeat_files[i], None)
+                        write_heartbeat(self.heartbeat_files[i], "ok")
                     except OSError:
-                        open(self.heartbeat_files[i], "w").close()
+                        pass
                     argv += ["--heartbeat-file", self.heartbeat_files[i]]
                 env = {**os.environ,
                        "JAX_COORDINATOR_ADDRESS": coord,
@@ -300,6 +352,14 @@ class GangSupervisor(Supervisor):
                     for i, hb in enumerate(self.heartbeat_files):
                         if codes[i] == 0:
                             continue  # finished members stop beating
+                        status = read_heartbeat_status(hb)
+                        if status.startswith("dead"):
+                            self.log(f"[elastic] gang member {i} "
+                                     f"health verdict {status!r} — "
+                                     f"killing the gang for a "
+                                     f"checkpoint restart")
+                            self._kill_gang(children)
+                            return -9, time.monotonic() - t0
                         try:
                             hb_seen[i] = max(hb_seen[i],
                                              os.path.getmtime(hb))

@@ -33,6 +33,15 @@ from shallowspeed_tpu.utils import pvary_over as _pvary
 tree_map = jax.tree_util.tree_map
 
 
+def _note_step(engine, pack):
+    # health.note_step, imported lazily (telemetry stays off the module
+    # import path): stores last_health + device-side cumulative counters
+    from shallowspeed_tpu.telemetry.health import note_step
+
+    note_step(engine, pack)
+
+
+
 class FusedDPEngine:
     """One-executable data-parallel trainer over the 'dp' axis of the mesh.
 
@@ -41,8 +50,14 @@ class FusedDPEngine:
     single stage) — verified against the VM in tests.
     """
 
-    def __init__(self, stage: MLPStage, optimizer, mesh: Mesh):
+    def __init__(self, stage: MLPStage, optimizer, mesh: Mesh,
+                 health: str = "off"):
+        from shallowspeed_tpu.telemetry.health import MODES
+
         assert stage.n_stages == 1
+        assert health in MODES, health
+        self.health = health
+        self.last_health = None
         self.stage = stage
         self.optimizer = optimizer
         # accept a (dp, 1) 2-D mesh or a 1-D ('dp',) mesh
@@ -60,11 +75,13 @@ class FusedDPEngine:
         stage_ref = self.stage
         opt_ref = self.optimizer
 
-        def local_step(params, opt_state, x_mu, y_mu):
-            """Per-device batch step on (n_mu, mubs, d) microbatch stacks:
-            grad-accumulating scan over microbatches (`layers.py:135-136`
-            semantics), one bucketed psum over 'dp' (`pipe.py:302-327`
-            equivalent), optimizer update. Shared by _step and _epoch."""
+        def batch_grads(params, x_mu, y_mu):
+            """The ONE encoding of the per-device gradient computation
+            on (n_mu, mubs, d) microbatch stacks: grad-accumulating
+            scan over microbatches (`layers.py:135-136` semantics),
+            one bucketed psum over 'dp' (`pipe.py:302-327` equivalent).
+            Shared by the plain and health-instrumented steps so the
+            two can never train differently."""
 
             def mu_body(acc, xy):
                 x, y = xy
@@ -76,15 +93,47 @@ class FusedDPEngine:
             # per dp shard — cast the carry to varying for shard_map's typing
             acc0 = _pvary(zero_grads_like(params), ("dp",))
             acc, _ = jax.lax.scan(mu_body, acc0, (x_mu, y_mu))
-            total = tree_map(lambda g: jax.lax.psum(g, "dp"), acc)
-            return opt_ref.step(params, total, opt_state)
+            return tree_map(lambda g: jax.lax.psum(g, "dp"), acc)
+
+        def local_step(params, opt_state, x_mu, y_mu):
+            """batch_grads + optimizer update (the _epoch/_run body)."""
+            return opt_ref.step(params, batch_grads(params, x_mu, y_mu),
+                                opt_state)
+
+        health_mode = health
+
+        def step_with_health(params, opt_state, x_mu, y_mu):
+            """local_step + the fused health pack (telemetry/health.py):
+            grads after the dp psum are replicated, so the pack needs no
+            further reductions; under "guard" the update is gated on the
+            nonfinite sentinel (optim.guarded_step — a skipped step is
+            bit-identical to never having run)."""
+            from shallowspeed_tpu.telemetry.health import (grad_health,
+                                                           update_health)
+
+            total = batch_grads(params, x_mu, y_mu)
+            pack = grad_health(params, total)
+            if health_mode == "guard":
+                ok = pack["nonfinite"] == 0
+                new_p, new_s = opt_ref.guarded_step(params, total,
+                                                    opt_state, ok)
+                pack = update_health(pack, params, new_p,
+                                     skipped=1 - ok)
+            else:
+                new_p, new_s = opt_ref.step(params, total, opt_state)
+                pack = update_health(pack, params, new_p)
+            return new_p, new_s, pack
+
+        step_out = ((P(), P()) if health == "off" else (P(), P(), P()))
 
         @partial(jax.jit, donate_argnums=(0, 1))
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), P(), P("dp"), P("dp")),
-                 out_specs=(P(), P()))
+                 out_specs=step_out)
         def _step(params, opt_state, xs, ys):
-            return local_step(params, opt_state, xs[0], ys[0])
+            if health_mode == "off":
+                return local_step(params, opt_state, xs[0], ys[0])
+            return step_with_health(params, opt_state, xs[0], ys[0])
 
         @partial(jax.jit)
         @partial(shard_map, mesh=mesh, in_specs=(P(), P("dp")),
@@ -138,8 +187,10 @@ class FusedDPEngine:
             ys = jax.device_put(ys, self.shard4)
             if self._telemetry_eps is None and tracer().level != "off":
                 self._record_entrypoints(xs, ys)
-            self.params, self.opt_state = self._step(
-                self.params, self.opt_state, xs, ys)
+            out = self._step(self.params, self.opt_state, xs, ys)
+            self.params, self.opt_state = out[0], out[1]
+            if self.health != "off":
+                _note_step(self, out[2])
             sp.fence(self.params[0]["b"])
 
     def infer(self, x: np.ndarray) -> jax.Array:
@@ -192,6 +243,15 @@ class FusedDPEngine:
         """(name, fn, SDS args) for telemetry's static accounting
         (report.py); empty before the first traced `train_batch`."""
         return list(self._telemetry_eps or ())
+
+    def health_snapshot(self) -> dict | None:
+        """The last train_batch's health pack as a host dict (one
+        device_get); None before the first step or with health='off'.
+        The fused train_epoch/train_run paths do not carry the pack —
+        drivers step per-batch when health is on."""
+        from shallowspeed_tpu.telemetry.health import engine_snapshot
+
+        return engine_snapshot(self)
 
     # -------------------------------------------------- checkpoint interface
 

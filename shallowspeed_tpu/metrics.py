@@ -25,8 +25,11 @@ class MetricsLogger:
         self.path = Path(path) if path else None
         self._t0 = time.time()
         if self.path:
+            from shallowspeed_tpu.telemetry.schema import SCHEMA_VERSION
+
             self.path.parent.mkdir(parents=True, exist_ok=True)
-            self.log(event="run_start", **run_info)
+            self.log(event="run_start", schema_version=SCHEMA_VERSION,
+                     **run_info)
 
     def log(self, **fields) -> None:
         if not self.path:
@@ -68,7 +71,7 @@ class StepRates:
     """
 
     def __init__(self, tokens_per_step: float, clock=time.time,
-                 telemetry=None):
+                 telemetry=None, health=None):
         self.tokens_per_step = float(tokens_per_step)
         self._clock = clock
         self._t0 = clock()
@@ -81,6 +84,11 @@ class StepRates:
         # live/static, per-axis collective bytes + implied GB/s over
         # the closed window, recompile counter, bubble fractions)
         self.telemetry = telemetry
+        # optional telemetry.health.HealthMonitor: when set, every
+        # log_point line additionally carries the training-health
+        # fields (grad/param norms, update ratio, nonfinite counter,
+        # skipped-step counter, anomaly verdicts)
+        self.health = health
 
     def pause(self, seconds: float) -> None:
         """Exclude `seconds` of non-training wall time (val eval, ckpt
@@ -101,6 +109,8 @@ class StepRates:
         cum = self.tokens_per_step * self._steps / cum_secs
         self._win_t, self._win_pause = now, self._pause
         out = {"tokens_per_sec": win, "tokens_per_sec_cum": cum}
+        if self.health is not None:
+            out.update(self.health.step_fields())
         if self.telemetry is not None:
             out.update(self.telemetry.step_fields(
                 window_secs=win_secs,

@@ -114,6 +114,8 @@ def clip_by_global_norm(grads: Any, max_norm: float,
     return tree_map(lambda g: (g * scale).astype(g.dtype), grads)
 
 
+
+
 # ------------------------------------------------------------ optimizers
 
 
@@ -141,6 +143,24 @@ class _Optimizer:
         if self.grad_clip is not None:
             return clip_by_global_norm(grads, self.grad_clip, self.clip_axes)
         return grads
+
+    def guarded_step(self, params: Any, grads: Any, state: Any, ok):
+        """`step` with the whole update gated on the traced bool `ok`
+        (shape (), e.g. the health pack's `nonfinite == 0` sentinel):
+        when `ok` is False every parameter AND optimizer-state leaf —
+        moments, step counters, schedule state — is the old value,
+        bit-identical, so a skipped step is indistinguishable from
+        never having run. This is the `skip_step` guard the health
+        layer (`telemetry/health.py`) compiles into the engines' train
+        steps; it lives here, next to `_prep`'s clipping, because both
+        gate the update on the same global gradient statistics."""
+        new_p, new_s = self.step(params, grads, state)
+
+        def keep(new, old):
+            return jnp.where(ok, new, old)
+
+        return (tree_map(keep, new_p, params),
+                tree_map(keep, new_s, state))
 
     def map_state_trees(self, state: Any, fn) -> Any:
         """Apply `fn` — a params-shaped-tree -> params-shaped-tree

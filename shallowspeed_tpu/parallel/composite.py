@@ -42,13 +42,13 @@ class Composite3DEngine(GSPMDEngine):
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  seed: int = 0, zero1: bool = False, fsdp: bool = False,
-                 zero2: bool = False):
+                 zero2: bool = False, health: str = "off"):
         if fsdp and (zero1 or zero2):
             raise ValueError("fsdp already shards the optimizer state; "
                              "drop zero1/zero2")
         self.fsdp = fsdp
         super().__init__(cfg, optimizer, mesh, seed=seed, zero1=zero1,
-                         zero2=zero2)
+                         zero2=zero2, health=health)
 
     def validate(self, cfg: T.TransformerConfig, mesh: Mesh) -> None:
         assert mesh.axis_names == ("dp", "sp", "tp"), (

@@ -41,6 +41,15 @@ from shallowspeed_tpu.utils import pvary_over
 tree_map = jax.tree_util.tree_map
 
 
+def _note_step(engine, pack):
+    # health.note_step, imported lazily (telemetry stays off the module
+    # import path): stores last_health + device-side cumulative counters
+    from shallowspeed_tpu.telemetry.health import note_step
+
+    note_step(engine, pack)
+
+
+
 class ContextParallelEngine:
     """Data x sequence parallel trainer for the transformer LM family.
 
@@ -64,10 +73,16 @@ class ContextParallelEngine:
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  seed: int = 0, attn: str = "ring", zero1: bool = False,
-                 zero2: bool = False, accum: int = 1):
+                 zero2: bool = False, accum: int = 1,
+                 health: str = "off"):
+        from shallowspeed_tpu.telemetry.health import MODES
+
         assert mesh.axis_names == ("dp", "sp")
         assert not (zero1 and zero2), "zero2 subsumes zero1"
         assert accum >= 1, accum
+        assert health in MODES, health
+        self.health = health
+        self.last_health = None
         self.accum = accum
         self.cfg = cfg
         self.mesh = mesh
@@ -209,6 +224,22 @@ class ContextParallelEngine:
                 lambda g: jax.lax.psum(g, ("dp", "sp")) * scale, gsum)
             return loss, grads
 
+        health_mode = health
+
+        def maybe_pack(params, grads, grad_specs=None):
+            """The health pack for this engine's fully reduced grads:
+            replicated leaves need no psum; ZeRO-2's dp-scattered
+            leaves psum their statistics over the axes their spec
+            shards (health.spec_axes). None with health='off'."""
+            if health_mode == "off":
+                return None
+            from shallowspeed_tpu.telemetry.health import (grad_health,
+                                                           spec_axes)
+
+            gax = spec_axes(grad_specs) if grad_specs is not None \
+                else None
+            return grad_health(params, grads, grad_axes=gax)
+
         if zero2:
             from shallowspeed_tpu.parallel.zero import (
                 make_zero1_update, shard_state_zero1, zero2_grad_specs)
@@ -225,10 +256,13 @@ class ContextParallelEngine:
                      for sp in jax.tree_util.tree_leaves(
                          gspecs, is_leaf=lambda x: isinstance(x, P))]
 
+            z2_out = ((P(), gspecs) if health == "off"
+                      else (P(), gspecs, P()))
+
             @jax.jit
             @partial(shard_map, mesh=mesh,
                      in_specs=(P(), P("dp", "sp"), P("dp", "sp"), P()),
-                     out_specs=(P(), gspecs))
+                     out_specs=z2_out)
             def _loss_grads(params, tokens, targets, step):
                 # pvary the params: cotangents then arrive as per-tile
                 # PARTIALS (no auto-psum), and the reduction is ours to
@@ -250,45 +284,71 @@ class ContextParallelEngine:
                             g, "dp", scatter_dimension=dim, tiled=True)
                     out.append(g * gscale)
                 grads = jax.tree_util.tree_unflatten(tdef, out)
-                return loss, grads
+                if health_mode == "off":
+                    return loss, grads
+                return loss, grads, maybe_pack(params, grads, gspecs)
 
             self.opt_state = shard_state_zero1(self.opt_state, mesh)
             self._loss_grads_fn = _loss_grads
             self._update_fn = make_zero1_update(
-                opt, self.params, self.opt_state)
+                opt, self.params, self.opt_state, health=health)
             self._step_fn = None
             self._run_fn = None
         elif zero1:
             from shallowspeed_tpu.parallel.zero import (
                 make_zero1_update, shard_state_zero1)
 
+            z1_out = ((P(), P()) if health == "off" else (P(), P(), P()))
+
             @jax.jit
             @partial(shard_map, mesh=mesh,
                      in_specs=(P(), P("dp", "sp"), P("dp", "sp"), P()),
-                     out_specs=(P(), P()))
+                     out_specs=z1_out)
             def _loss_grads(params, tokens, targets, step):
                 # ZeRO-1 grad program: the grads leave the shard_map
                 # already psum'd (invariant), ready for the dp-sharded
                 # optimizer update.
-                return loss_and_grads(params, tokens, targets, step)
+                loss, grads = loss_and_grads(params, tokens, targets,
+                                             step)
+                if health_mode == "off":
+                    return loss, grads
+                return loss, grads, maybe_pack(params, grads)
 
             self.opt_state = shard_state_zero1(self.opt_state, mesh)
             self._loss_grads_fn = _loss_grads
             self._update_fn = make_zero1_update(
-                opt, self.params, self.opt_state)
+                opt, self.params, self.opt_state, health=health)
             self._step_fn = None
             self._run_fn = None
         else:
+            step_out = ((P(), P(), P()) if health == "off"
+                        else (P(), P(), P(), P()))
 
             @partial(jax.jit, donate_argnums=(0, 1))
             @partial(shard_map, mesh=mesh,
                      in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp"),
                                P()),
-                     out_specs=(P(), P(), P()))
+                     out_specs=step_out)
             def _step(params, opt_state, tokens, targets, step):
                 loss, grads = loss_and_grads(params, tokens, targets, step)
-                params, opt_state = opt.step(params, grads, opt_state)
-                return params, opt_state, loss
+                if health_mode == "off":
+                    params, opt_state = opt.step(params, grads,
+                                                 opt_state)
+                    return params, opt_state, loss
+                from shallowspeed_tpu.telemetry.health import (
+                    update_health)
+
+                pack = maybe_pack(params, grads)
+                if health_mode == "guard":
+                    ok = pack["nonfinite"] == 0
+                    new_p, new_s = opt.guarded_step(params, grads,
+                                                    opt_state, ok)
+                    pack = update_health(pack, params, new_p,
+                                         skipped=1 - ok)
+                else:
+                    new_p, new_s = opt.step(params, grads, opt_state)
+                    pack = update_health(pack, params, new_p)
+                return new_p, new_s, loss, pack
 
             self._step_fn = _step
 
@@ -384,25 +444,42 @@ class ContextParallelEngine:
 
         step = np.uint32(self._step_count)
         self._step_count += 1
+        monitored = self.health != "off"
         with tracer().span("step", step=int(step)) as sp:
             if self._step_fn is None:  # ZeRO-1/2: grads + sharded update
                 with tracer().span("grads", step=int(step)) as g:
-                    loss, grads = self._loss_grads_fn(
+                    out = self._loss_grads_fn(
                         self.params, self._place(tokens),
                         self._place(targets), step)
+                    loss, grads = out[0], out[1]
                     g.fence(loss)
                 with tracer().span("update", step=int(step)) as u:
                     if self._telemetry_eps is None \
                             and tracer().level != "off":
                         self._record_entrypoints(tokens, targets,
                                                  grads=grads)
-                    self.params, self.opt_state = self._update_fn(
-                        self.params, grads, self.opt_state)
+                    if self.health == "guard":
+                        self.params, self.opt_state, upd = \
+                            self._update_fn(self.params, grads,
+                                            self.opt_state,
+                                            out[2]["nonfinite"] == 0)
+                        _note_step(self, {**out[2], **upd})
+                    elif monitored:
+                        self.params, self.opt_state, upd = \
+                            self._update_fn(self.params, grads,
+                                            self.opt_state)
+                        _note_step(self, {**out[2], **upd})
+                    else:
+                        self.params, self.opt_state = self._update_fn(
+                            self.params, grads, self.opt_state)
                     u.fence(self.opt_state)
             else:
-                self.params, self.opt_state, loss = self._step_fn(
+                out = self._step_fn(
                     self.params, self.opt_state,
                     self._place(tokens), self._place(targets), step)
+                self.params, self.opt_state, loss = out[:3]
+                if monitored:
+                    _note_step(self, out[3])
                 if self._telemetry_eps is None \
                         and tracer().level != "off":
                     self._record_entrypoints(tokens, targets)
@@ -428,6 +505,14 @@ class ContextParallelEngine:
         (report.py convention); empty before the first traced step."""
         return list(self._telemetry_eps or ())
 
+    def health_snapshot(self) -> dict | None:
+        """The last step's health pack as a plain host dict (one
+        device_get — call at log points); None before the first step
+        or with health='off'."""
+        from shallowspeed_tpu.telemetry.health import engine_snapshot
+
+        return engine_snapshot(self)
+
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         """One optimizer step on a (B, T) int token batch; returns the loss."""
         return float(self.train_batch_async(tokens, targets))
@@ -440,6 +525,10 @@ class ContextParallelEngine:
         assert self._run_fn is not None, (
             "train_run needs the dense engine (zero1/zero2 step on the "
             "host between grad programs)")
+        assert self.health == "off", (
+            "train_run fuses many steps into one dispatch; the per-step "
+            "health pack (and the guard) lives in the train_batch path "
+            "— build the engine with health='off' for fused runs")
         s, b, t = tokens.shape
         assert t % self.sp == 0 and t <= self.cfg.max_seq, (t, self.sp)
         assert (b * jax.process_count()) % self.dp == 0, (b, self.dp)

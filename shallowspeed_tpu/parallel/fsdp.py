@@ -66,12 +66,14 @@ class FSDPEngine(GSPMDEngine):
     """
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0, zero1: bool = False, zero2: bool = False):
+                 seed: int = 0, zero1: bool = False, zero2: bool = False,
+                 health: str = "off"):
         if zero1 or zero2:
             raise ValueError(
                 "FSDP already shards the optimizer state (ZeRO-3 is a "
                 "superset of ZeRO-1/2); drop zero1/zero2")
-        super().__init__(cfg, optimizer, mesh, seed=seed, zero1=False)
+        super().__init__(cfg, optimizer, mesh, seed=seed, zero1=False,
+                         health=health)
 
     def validate(self, cfg: T.TransformerConfig, mesh: Mesh) -> None:
         assert mesh.axis_names == ("dp",), (

@@ -22,6 +22,15 @@ from shallowspeed_tpu.models import transformer as T
 tree_map = jax.tree_util.tree_map
 
 
+def _note_step(engine, pack):
+    # health.note_step, imported lazily (telemetry stays off the module
+    # import path): stores last_health + device-side cumulative counters
+    from shallowspeed_tpu.telemetry.health import note_step
+
+    note_step(engine, pack)
+
+
+
 class GSPMDEngine:
     """Data x model parallel trainer: batch over 'dp' (the first mesh
     axis), parameters placed per `self.param_specs(cfg)`."""
@@ -32,11 +41,17 @@ class GSPMDEngine:
     canonical_opt_identity = True
 
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
-                 seed: int = 0, zero1: bool = False, zero2: bool = False):
+                 seed: int = 0, zero1: bool = False, zero2: bool = False,
+                 health: str = "off"):
+        from shallowspeed_tpu.telemetry.health import MODES
+
         assert not (zero1 and zero2), "zero2 subsumes zero1"
+        assert health in MODES, health
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
+        self.health = health
+        self.last_health = None
         self.validate(cfg, mesh)
         self.dp = mesh.devices.shape[0]
 
@@ -86,16 +101,26 @@ class GSPMDEngine:
                 out_sh = (NamedSharding(mesh, P()), gshard)
             else:
                 out_sh = None
+            if health != "off" and out_sh is not None:
+                out_sh = (*out_sh, None)
 
             @partial(jax.jit, out_shardings=out_sh)
             def _grads(params, tokens, targets, step):
-                return jax.value_and_grad(
+                loss, grads = jax.value_and_grad(
                     lambda p: T.loss(p, tokens, targets, cfg,
                                      dropout_key=train_key(step)))(params)
+                if health == "off":
+                    return loss, grads
+                # GSPMD program: plain jnp reductions are global (no
+                # per-leaf spec axes); the update half of the pack
+                # (update_ratio, skipped) rides the update program
+                from shallowspeed_tpu.telemetry.health import grad_health
+
+                return loss, grads, grad_health(params, grads)
 
             self._grads_fn = _grads
             self._update_fn = make_zero1_update(
-                opt, self.params, self.opt_state)
+                opt, self.params, self.opt_state, health=health)
             self._step_fn = None
         else:
             # pin the step's outputs to the DECLARED placements
@@ -109,14 +134,35 @@ class GSPMDEngine:
             out_sh = (self.shardings,
                       tree_map(lambda l: l.sharding, self.opt_state),
                       self.rep)
+            if health != "off":
+                out_sh = (*out_sh, None)  # + the health pack
 
             @partial(jax.jit, donate_argnums=(0, 1), out_shardings=out_sh)
             def _step(params, opt_state, tokens, targets, step):
                 loss, grads = jax.value_and_grad(
                     lambda p: T.loss(p, tokens, targets, cfg,
                                      dropout_key=train_key(step)))(params)
-                params, opt_state = opt.step(params, grads, opt_state)
-                return params, opt_state, loss
+                if health == "off":
+                    params, opt_state = opt.step(params, grads, opt_state)
+                    return params, opt_state, loss
+                # health pack fused into the one step executable (zero
+                # extra entrypoints); under "guard" the update is gated
+                # on the nonfinite sentinel — a skipped step leaves
+                # params and moments bit-identical (optim.guarded_step)
+                from shallowspeed_tpu.telemetry.health import (
+                    grad_health, update_health)
+
+                pack = grad_health(params, grads)
+                if health == "guard":
+                    ok = pack["nonfinite"] == 0
+                    new_p, new_s = opt.guarded_step(params, grads,
+                                                    opt_state, ok)
+                    pack = update_health(pack, params, new_p,
+                                         skipped=1 - ok)
+                else:
+                    new_p, new_s = opt.step(params, grads, opt_state)
+                    pack = update_health(pack, params, new_p)
+                return new_p, new_s, loss, pack
 
             self._step_fn = _step
         self._eval_fn = jax.jit(
@@ -176,25 +222,44 @@ class GSPMDEngine:
 
         step = np.uint32(self._step_count)
         self._step_count += 1
+        monitored = self.health != "off"
         with tracer().span("step", step=int(step)) as sp:
             if self._step_fn is None:  # ZeRO-1/2: grad program + update
                 with tracer().span("grads", step=int(step)) as g:
-                    loss, grads = self._grads_fn(
+                    out = self._grads_fn(
                         self.params, self._place(tokens),
                         self._place(targets), step)
+                    loss, grads = out[0], out[1]
                     g.fence(loss)
                 with tracer().span("update", step=int(step)) as u:
                     if self._telemetry_eps is None \
                             and tracer().level != "off":
                         self._record_entrypoints(tokens, targets,
                                                  grads=grads)
-                    self.params, self.opt_state = self._update_fn(
-                        self.params, grads, self.opt_state)
+                    if self.health == "guard":
+                        pack = out[2]
+                        self.params, self.opt_state, upd = \
+                            self._update_fn(self.params, grads,
+                                            self.opt_state,
+                                            pack["nonfinite"] == 0)
+                        _note_step(self, {**pack, **upd})
+                    elif monitored:
+                        pack = out[2]
+                        self.params, self.opt_state, upd = \
+                            self._update_fn(self.params, grads,
+                                            self.opt_state)
+                        _note_step(self, {**pack, **upd})
+                    else:
+                        self.params, self.opt_state = self._update_fn(
+                            self.params, grads, self.opt_state)
                     u.fence(self.opt_state)
             else:
-                self.params, self.opt_state, loss = self._step_fn(
+                out = self._step_fn(
                     self.params, self.opt_state,
                     self._place(tokens), self._place(targets), step)
+                self.params, self.opt_state, loss = out[:3]
+                if monitored:
+                    _note_step(self, out[3])
                 if self._telemetry_eps is None \
                         and tracer().level != "off":
                     self._record_entrypoints(tokens, targets)
@@ -221,6 +286,14 @@ class GSPMDEngine:
         the first step has run under an active tracer (the skeletons
         come from real batches)."""
         return list(self._telemetry_eps or ())
+
+    def health_snapshot(self) -> dict | None:
+        """The last step's health pack as a plain host dict (one
+        device_get — call at log points, like every telemetry fetch);
+        None before the first step or with health='off'."""
+        from shallowspeed_tpu.telemetry.health import engine_snapshot
+
+        return engine_snapshot(self)
 
     def train_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
         return float(self.train_batch_async(tokens, targets))

@@ -107,6 +107,15 @@ from shallowspeed_tpu.utils import pvary_over as _pvary
 tree_map = jax.tree_util.tree_map
 
 
+def _note_step(engine, pack):
+    # health.note_step, imported lazily (telemetry stays off the module
+    # import path): stores last_health + device-side cumulative counters
+    from shallowspeed_tpu.telemetry.health import note_step
+
+    note_step(engine, pack)
+
+
+
 def stack_blocks(params: dict) -> dict:
     """blocks: list of per-layer dicts -> one dict with a leading layer
     axis on every leaf (the axis that shards over 'pp')."""
@@ -144,7 +153,13 @@ class PipelineLMEngine:
                  n_mubatches: int = 4, seed: int = 0,
                  schedule: str = "gpipe", attn: str = "xla",
                  virtual_pp: int = 1, zero1: bool = False,
-                 zero2: bool = False, fsdp: bool = False):
+                 zero2: bool = False, fsdp: bool = False,
+                 health: str = "off"):
+        from shallowspeed_tpu.telemetry.health import MODES
+
+        assert health in MODES, health
+        self.health = health
+        self.last_health = None
         assert mesh.axis_names in (("dp", "pp"), ("dp", "pp", "tp"),
                                    ("dp", "pp", "sp"),
                                    ("dp", "pp", "ep")), (
@@ -399,10 +414,27 @@ class PipelineLMEngine:
         hd = cfg.head_dim
 
         if self.has_tp:
+            # Megatron conjugate pair (utils.py): psum_tp after the
+            # row-parallel matmuls, enter_tp where the replicated
+            # residual stream feeds column-parallel compute. On VMA jax
+            # enter_tp is identity and psum_tp a plain lax.psum; on
+            # pre-VMA jax both carry explicit custom VJPs — autodiff
+            # straight through a bare psum there double-counted the
+            # sharded-weight grads tp x and left the replicated-param
+            # cotangents shard-partial (caught by the health pack's
+            # oracle parity, round 7).
+            from shallowspeed_tpu.utils import tp_allreduce, tp_region_enter
+
             def psum_tp(x):
-                return jax.lax.psum(x, "tp")
+                return tp_allreduce(x, "tp")
+
+            def enter_tp(x):
+                return tp_region_enter(x, "tp")
         else:
             def psum_tp(x):
+                return x
+
+            def enter_tp(x):
                 return x
 
         w = cfg.attn_window  # windows compose with every substrate
@@ -459,7 +491,7 @@ class PipelineLMEngine:
             k_attn = k_ffn = None
             if key is not None and cfg.dropout > 0.0:
                 k_attn, k_ffn = jax.random.split(key)
-            h = T._norm(blk["ln1"], x, cfg)
+            h = enter_tp(T._norm(blk["ln1"], x, cfg))
             if cfg.gqa:  # split projections; each shard owns whole groups
                 q = (h @ blk["q"]["W"] + blk["q"]["b"]).reshape(
                     b, t, heads_local, hd)
@@ -482,7 +514,7 @@ class PipelineLMEngine:
             x = x + T._dropout(
                 psum_tp(a @ blk["proj"]["W"]) + blk["proj"]["b"],
                 cfg.dropout, k_attn)
-            h = T._norm(blk["ln2"], x, cfg)
+            h = enter_tp(T._norm(blk["ln2"], x, cfg))
             aux = jnp.float32(0.0)
             if cfg.n_experts > 0:
                 from shallowspeed_tpu.ops.moe import moe_ffn, moe_ffn_ep
@@ -754,13 +786,19 @@ class PipelineLMEngine:
                 grads = jax.tree_util.tree_unflatten(tdef, g_leaves)
                 loss = jax.lax.psum(loss, "pp")
                 return jax.lax.pmean(loss, "dp"), grads
+            # pvary the params and reduce each leaf EXPLICITLY over the
+            # axes it is invariant on (reduce_plain — the same per-leaf
+            # contract the 1F1B/zb/vpp paths use). Round 7: this branch
+            # used to lean on variance-typed autodiff for the grad
+            # reductions, which pre-VMA jax (check_rep=False shim)
+            # simply does not have — head/ln_f grads came back as one
+            # device's zero partial (never trained) and dp>1 grads
+            # stayed per-tile partials; caught by the health pack's
+            # oracle parity, invisible to the loss-only parity tests.
             (loss, _), grads = jax.value_and_grad(
-                local_loss, has_aux=True)(params, tokens, targets, key)
-            # variance typing does the reductions: block grads arrive
-            # psum'd over dp (+sp/+ep) (params invariant there — expert
-            # leaves, ep-sharded, reduce over dp only), embed/head grads
-            # psum'd over every mesh axis they're invariant on. The loss
-            # PARTIAL still needs its value reduction here.
+                local_loss, has_aux=True)(
+                    _pvary(params, vary_axes), tokens, targets, key)
+            grads = reduce_plain(grads)
             loss = jax.lax.psum(loss,
                                 ("pp", "sp") if self.has_sp else "pp")
             return jax.lax.pmean(loss, data_axes), grads
@@ -1484,6 +1522,23 @@ class PipelineLMEngine:
         pspecs, ospecs = self._pspecs, self._opt_specs
         use_1f1b = self.schedule in ("1f1b", "zb")
         seed = self._seed
+        health = self.health
+
+        def make_pack(params, grads, grad_specs, param_specs):
+            """The health pack for this engine's reduced grads
+            (telemetry/health.py): each leaf's statistic psums over the
+            axes its spec shards — 'pp' block stacks (incl. the zb /
+            interleaved-vpp permuted stacks, which still partition the
+            params over 'pp'), '+tp'/'+ep' Megatron/expert shards, and
+            '+dp' for the ZeRO-2/fsdp scattered layout — so the pack is
+            globally correct in-program on every mesh this engine
+            takes."""
+            from shallowspeed_tpu.telemetry.health import (grad_health,
+                                                           spec_axes)
+
+            return grad_health(params, grads,
+                               grad_axes=spec_axes(grad_specs),
+                               param_axes=spec_axes(param_specs))
         # data specs: microbatch axis unsharded, rows over dp (and over
         # ep when the mesh has one — ep multiplies the data dimension),
         # sequence over sp when the mesh has one
@@ -1510,14 +1565,36 @@ class PipelineLMEngine:
             grads = tree_map(lambda g: g / (self.dp * self.ep), grads)
             return loss, grads
 
+        step_out = ((pspecs, ospecs, P()) if health == "off"
+                    else (pspecs, ospecs, P(), P()))
+
         @partial(jax.jit, donate_argnums=(0, 1))
         @partial(shard_map, mesh=self.mesh,
                  in_specs=(pspecs, ospecs, dspec, dspec, P()),
-                 out_specs=(pspecs, ospecs, P()))
+                 out_specs=step_out)
         def _step(params, opt_state, tokens, targets, step):
             loss, grads = _batch_grads(params, tokens, targets, step)
-            params, opt_state = opt.step(params, grads, opt_state)
-            return params, opt_state, loss
+            if health == "off":
+                params, opt_state = opt.step(params, grads, opt_state)
+                return params, opt_state, loss
+            from shallowspeed_tpu.telemetry.health import (spec_axes,
+                                                           update_health)
+
+            pack = make_pack(params, grads, pspecs, pspecs)
+            pax = spec_axes(pspecs)
+            if health == "guard":
+                # all stages see the same (psum'd) sentinel, so the
+                # whole pipeline skips in lockstep, bit-identically
+                ok = pack["nonfinite"] == 0
+                new_p, new_s = opt.guarded_step(params, grads,
+                                                opt_state, ok)
+                pack = update_health(pack, params, new_p,
+                                     param_axes=pax, skipped=1 - ok)
+            else:
+                new_p, new_s = opt.step(params, grads, opt_state)
+                pack = update_health(pack, params, new_p,
+                                     param_axes=pax)
+            return new_p, new_s, loss, pack
 
         # ZeRO-1 x pp: the moments shard over 'dp' ON TOP of their
         # pp-sharded block placement (zero.py adds 'dp' to the first
@@ -1527,12 +1604,18 @@ class PipelineLMEngine:
         # 1/dp slice of its pipeline stage and XLA all-gathers the new
         # params over 'dp' only (same split-step recipe as the context
         # engine's zero1 path).
+        lg_out = ((P(), pspecs) if health == "off"
+                  else (P(), pspecs, P()))
+
         @jax.jit
         @partial(shard_map, mesh=self.mesh,
                  in_specs=(pspecs, dspec, dspec, P()),
-                 out_specs=(P(), pspecs))
+                 out_specs=lg_out)
         def _loss_grads(params, tokens, targets, step):
-            return _batch_grads(params, tokens, targets, step)
+            loss, grads = _batch_grads(params, tokens, targets, step)
+            if health == "off":
+                return loss, grads
+            return loss, grads, make_pack(params, grads, pspecs, pspecs)
 
         @jax.jit
         @partial(shard_map, mesh=self.mesh,
@@ -1585,15 +1668,24 @@ class PipelineLMEngine:
                 return jax.tree_util.tree_unflatten(tdef, full)
 
             in_pspec = self._gspecs2 if fsdp else pspecs
+            lg2_out = ((P(), self._gspecs2) if health == "off"
+                       else (P(), self._gspecs2, P()))
 
             @jax.jit
             @partial(shard_map, mesh=self.mesh,
                      in_specs=(in_pspec, dspec, dspec, P()),
-                     out_specs=(P(), self._gspecs2))
+                     out_specs=lg2_out)
             def _loss_grads2(params, tokens, targets, step):
+                params_in = params
                 if fsdp:
                     params = _gather_params(params)
-                return _z2_grads(params, tokens, targets, step)
+                loss, grads = _z2_grads(params, tokens, targets, step)
+                if health == "off":
+                    return loss, grads
+                # param stats on the RESTING (possibly dp-sharded)
+                # layout; grad stats on the dp-scattered ZeRO-2 layout
+                return loss, grads, make_pack(params_in, grads,
+                                              self._gspecs2, in_pspec)
 
             self._loss_grads_fn = _loss_grads2
 
@@ -1620,7 +1712,8 @@ class PipelineLMEngine:
             # clip axes: the global-norm reduction over pp/dp-sharded
             # leaves is GSPMD's job in this program)
             self._update_fn = make_zero1_update(
-                self.optimizer, self.params, self.opt_state)
+                self.optimizer, self.params, self.opt_state,
+                health=health)
             if self.zero1:
                 self._loss_grads_fn = _loss_grads
             self._step_fn = None
@@ -1669,23 +1762,40 @@ class PipelineLMEngine:
         step = np.uint32(self._step_count)
         self._step_count += 1
         tok, tgt = self.place(tokens), self.place(targets)
+        monitored = self.health != "off"
         with tracer().span("step", step=int(step),
                            schedule=self.schedule) as sp:
             if self._step_fn is None:  # zero1: grads + GSPMD update
                 with tracer().span("grads", step=int(step)) as g:
-                    loss, grads = self._loss_grads_fn(
+                    out = self._loss_grads_fn(
                         self.params, tok, tgt, step)
+                    loss, grads = out[0], out[1]
                     g.fence(loss)
                 with tracer().span("update", step=int(step)) as u:
                     if self._telemetry_eps is None \
                             and tracer().level != "off":
                         self._record_entrypoints(tok, tgt, grads=grads)
-                    self.params, self.opt_state = self._update_fn(
-                        self.params, grads, self.opt_state)
+                    if self.health == "guard":
+                        self.params, self.opt_state, upd = \
+                            self._update_fn(self.params, grads,
+                                            self.opt_state,
+                                            out[2]["nonfinite"] == 0)
+                        _note_step(self, {**out[2], **upd})
+                    elif monitored:
+                        self.params, self.opt_state, upd = \
+                            self._update_fn(self.params, grads,
+                                            self.opt_state)
+                        _note_step(self, {**out[2], **upd})
+                    else:
+                        self.params, self.opt_state = self._update_fn(
+                            self.params, grads, self.opt_state)
                     u.fence(self.opt_state)
             else:
-                self.params, self.opt_state, loss = self._step_fn(
+                out = self._step_fn(
                     self.params, self.opt_state, tok, tgt, step)
+                self.params, self.opt_state, loss = out[:3]
+                if monitored:
+                    _note_step(self, out[3])
                 if self._telemetry_eps is None \
                         and tracer().level != "off":
                     self._record_entrypoints(tok, tgt)
@@ -1718,6 +1828,14 @@ class PipelineLMEngine:
         engine's schedule (the executed tables' identity)."""
         return {"schedule": self.schedule, "n_mu": self.n_mu,
                 "pp": self.pp, "vpp": self.vpp}
+
+    def health_snapshot(self) -> dict | None:
+        """The last step's health pack as a host dict (one device_get —
+        call at log points); None before the first step or with
+        health='off'."""
+        from shallowspeed_tpu.telemetry.health import engine_snapshot
+
+        return engine_snapshot(self)
 
     def make_calibration_twin(self) -> "PipelineLMEngine":
         """A fresh engine at 2x microbatches for the two-point bubble

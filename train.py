@@ -85,6 +85,16 @@ def parse_args(argv=None):
                    help="write spans.jsonl + trace.json (Chrome/"
                         "Perfetto) + telemetry.json here; implies "
                         "--telemetry steps when the level is off")
+    p.add_argument("--health", default="off",
+                   choices=["off", "monitor", "guard"],
+                   help="training-health observability (telemetry/"
+                        "health.py): monitor = on-device grad/param "
+                        "norms + nonfinite sentinel inside every "
+                        "compiled step, anomaly verdicts per epoch "
+                        "line; guard = monitor + skip any update with "
+                        "non-finite gradients bit-identically. "
+                        "Disables the fused whole-epoch dispatch (the "
+                        "pack rides the per-batch step)")
     p.add_argument("--platform", type=str, default=None,
                    choices=["cpu", "tpu"],
                    help="force a JAX platform (this environment pins "
@@ -169,15 +179,18 @@ def build(args):
 
     if engine_kind == "fused":
         stage = MLPStage(LAYER_SIZES, 0, 1, batch_size=args.batch_size)
-        engine = FusedDPEngine(stage, optimizer, mesh)
+        engine = FusedDPEngine(stage, optimizer, mesh,
+                               health=args.health)
     elif engine_kind == "spmd":
         engine = SPMDPipelineEngine(LAYER_SIZES, optimizer, mesh,
                                     args.mubatches, mubatch_size,
-                                    args.batch_size)
+                                    args.batch_size,
+                                    health=args.health)
     else:
         stages = [MLPStage(LAYER_SIZES, s, pp, batch_size=args.batch_size)
                   for s in range(pp)]
-        engine = PipelineExecutor(mesh, stages, optimizer)
+        engine = PipelineExecutor(mesh, stages, optimizer,
+                                  health=args.health)
     return engine, train_ds, val_ds
 
 
@@ -260,10 +273,22 @@ def train(args) -> float:
             args.schedule, args.mubatches,
             args.pp)["bubble_fraction"])
 
+    # ---- training health: monitor fed at epoch log points (the pack
+    # itself is computed on device every batch; guard skips are
+    # enacted in-step regardless of the host cadence)
+    monitor = None
+    if args.health != "off":
+        from shallowspeed_tpu.telemetry.anomaly import GuardPolicy
+        from shallowspeed_tpu.telemetry.health import HealthMonitor
+
+        monitor = HealthMonitor(policy=GuardPolicy.for_mode(args.health))
+
     # Fused engines: stage the epoch's batches on device once (HBM-resident)
-    # and run each epoch as a single dispatch.
+    # and run each epoch as a single dispatch — unless health is on,
+    # whose per-step pack rides the per-batch step program.
     staged = (engine.stage_epoch(train_ds, n_batches)
-              if hasattr(engine, "train_epoch") else None)
+              if hasattr(engine, "train_epoch") and args.health == "off"
+              else None)
 
     profile_ctx = (jax.profiler.trace(args.profile_dir)
                    if args.profile_dir else contextlib.nullcontext())
@@ -275,11 +300,20 @@ def train(args) -> float:
             rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
                    f"Accuracy: {accuracy * 100:.2f}%")
             if args.heartbeat_file:
-                Path(args.heartbeat_file).touch()
+                from shallowspeed_tpu.elastic import write_heartbeat
+
+                write_heartbeat(args.heartbeat_file,
+                                monitor.heartbeat_status()
+                                if monitor is not None else "ok")
             t_epoch = time.time()
             trace_mark = 0
             if staged is not None:
                 engine.train_epoch(staged)
+            elif hasattr(engine, "train_epoch"):
+                # fused/spmd engines under --health: per-batch stepping
+                # (the health pack rides the batch step program)
+                for batch_id in range(n_batches):
+                    engine.train_batch(batch_id, train_ds)
             else:
                 for batch_id in range(n_batches):
                     if batch_id == n_batches - 1:
@@ -295,6 +329,15 @@ def train(args) -> float:
             jax.block_until_ready(engine.params)
             metrics.epoch(epoch, accuracy, n_batches * args.batch_size,
                           time.time() - t_epoch)
+            if monitor is not None:
+                # the last batch's pack + anomaly verdicts, once per
+                # epoch (the MLP driver has no step lines)
+                verdicts = monitor.observe(epoch, None,
+                                           engine.health_snapshot())
+                for v in verdicts:
+                    rprint(str(v))
+                metrics.log(event="health", step=epoch,
+                            **monitor.step_fields())
             if telem is not None:
                 # VM at the `spans` level: the per-instruction fenced
                 # spans ARE the executed schedule trace — replay the

@@ -265,6 +265,22 @@ def parse_args(argv=None):
                         "bubble (accurate attributed time; serializes "
                         "dispatch — a measurement mode, not a "
                         "throughput mode)")
+    p.add_argument("--health", default="off",
+                   choices=["off", "monitor", "guard"],
+                   help="training-health observability (shallowspeed_"
+                        "tpu.telemetry.health): monitor = compute the "
+                        "on-device health pack (grad/param norms, "
+                        "update ratio, nonfinite sentinel) inside every "
+                        "compiled step — zero extra executables — and "
+                        "run the streaming anomaly detector (loss/grad "
+                        "spikes, divergence, dead layers) over the "
+                        "step lines; guard = monitor + gate the "
+                        "optimizer update on the nonfinite sentinel "
+                        "(a poisoned step is skipped bit-identically, "
+                        "params and moments untouched). Health "
+                        "verdicts ride --heartbeat-file, so the "
+                        "elastic supervisor restarts a numerically "
+                        "dead run from the last good checkpoint")
     p.add_argument("--trace-dir", type=str, default="",
                    help="write the telemetry trace here: spans.jsonl "
                         "(streamed), trace.json (Chrome/Perfetto), "
@@ -629,7 +645,7 @@ def train(args) -> float:
                                   attn=pp_attn,
                                   virtual_pp=args.virtual_pp,
                                   zero1=args.zero1, zero2=args.zero2,
-                                  fsdp=args.fsdp)
+                                  fsdp=args.fsdp, health=args.health)
     elif composite:
         from shallowspeed_tpu.parallel.composite import Composite3DEngine
 
@@ -637,12 +653,13 @@ def train(args) -> float:
                     ("dp", "sp", "tp"))
         engine = Composite3DEngine(cfg, opt, mesh, seed=args.seed,
                                    zero1=args.zero1, zero2=args.zero2,
-                                   fsdp=args.fsdp)
+                                   fsdp=args.fsdp, health=args.health)
     elif args.fsdp:
         from shallowspeed_tpu.parallel.fsdp import FSDPEngine
 
         mesh = Mesh(devs.reshape(args.dp), ("dp",))
-        engine = FSDPEngine(cfg, opt, mesh, seed=args.seed)
+        engine = FSDPEngine(cfg, opt, mesh, seed=args.seed,
+                            health=args.health)
     elif args.ep > 1 or args.experts:
         from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
 
@@ -652,18 +669,21 @@ def train(args) -> float:
         else:
             mesh = Mesh(devs.reshape(args.dp, args.ep), ("dp", "ep"))
         engine = ExpertParallelEngine(cfg, opt, mesh, seed=args.seed,
-                                      zero1=args.zero1, zero2=args.zero2)
+                                      zero1=args.zero1, zero2=args.zero2,
+                                      health=args.health)
     elif args.tp > 1:
         from shallowspeed_tpu.parallel.tensor import TensorParallelEngine
 
         mesh = Mesh(devs.reshape(args.dp, args.tp), ("dp", "tp"))
         engine = TensorParallelEngine(cfg, opt, mesh, seed=args.seed,
-                                      zero1=args.zero1, zero2=args.zero2)
+                                      zero1=args.zero1, zero2=args.zero2,
+                                      health=args.health)
     else:
         mesh = Mesh(devs.reshape(args.dp, args.sp), ("dp", "sp"))
         engine = ContextParallelEngine(cfg, opt, mesh, seed=args.seed,
                                        attn=args.attn, zero1=args.zero1,
-                                       zero2=args.zero2, accum=args.accum)
+                                       zero2=args.zero2, accum=args.accum,
+                                       health=args.health)
 
     start_step = 0
     restored_ckpt = None
@@ -698,6 +718,17 @@ def train(args) -> float:
                             level=args.telemetry)
     telem = (tele.RunTelemetry(engine, tracer)
              if args.telemetry != "off" else None)
+    # ---- training health (telemetry/health.py): the engines compute
+    # the pack on device every step; the monitor fetches it at log
+    # points, runs the anomaly detectors, and its fields ride the same
+    # step lines. Heartbeats carry its verdict so the elastic
+    # supervisor can restart a numerically-dead run from checkpoint.
+    monitor = None
+    if args.health != "off":
+        from shallowspeed_tpu.telemetry.anomaly import GuardPolicy
+        from shallowspeed_tpu.telemetry.health import HealthMonitor
+
+        monitor = HealthMonitor(policy=GuardPolicy.for_mode(args.health))
     if telem is not None and hasattr(engine, "schedule_info"):
         # pipeline engines: the verified schedule's static bubble rides
         # on every step line from the start; the measured fraction
@@ -807,7 +838,8 @@ def train(args) -> float:
     # (the cumulative average buries the sustained rate under compile
     # time — round-4 endurance lesson). With telemetry on, every
     # log_point line additionally carries the telemetry fields.
-    rates = StepRates(args.batch_size * args.seq_len, telemetry=telem)
+    rates = StepRates(args.batch_size * args.seq_len, telemetry=telem,
+                      health=monitor)
     last_logged = start_step - 1
     loss = float("nan")
     from shallowspeed_tpu.data.prefetch import prefetch_to_device, sync_every
@@ -837,11 +869,39 @@ def train(args) -> float:
                 if ema is not None:
                     ema = ema_update(ema, engine.params, args.ema_decay)
                 if sync_every(step, args.log_every, args.steps):
-                    if args.heartbeat_file:
-                        # liveness signal for the elastic supervisor: a
-                        # stale mtime means the step loop is hung
-                        Path(args.heartbeat_file).touch()
                     loss = float(loss_dev)
+                    if monitor is not None:
+                        # one device_get for the pack, then the
+                        # streaming detectors; verdict fields ride the
+                        # step line via StepRates(health=...)
+                        verdicts = monitor.observe(
+                            step, loss, engine.health_snapshot())
+                        for v in verdicts:
+                            rprint(str(v))
+                        fatal = [v for v in verdicts
+                                 if v.action == "abort"]
+                        if fatal:
+                            if args.save_dir:
+                                save_ckpt(f"{args.save_dir}/diverged",
+                                          step)
+                                if saver is not None:
+                                    saver.wait()
+                            raise SystemExit(
+                                f"health policy abort at step {step}: "
+                                + "; ".join(v.detail for v in fatal))
+                    if args.heartbeat_file:
+                        # liveness + health signal for the elastic
+                        # supervisor: a stale mtime means a hung step
+                        # loop; a 'dead ...' status means a numerically
+                        # dead one (restart from the last good
+                        # checkpoint either way)
+                        from shallowspeed_tpu.elastic import (
+                            write_heartbeat)
+
+                        write_heartbeat(
+                            args.heartbeat_file,
+                            monitor.heartbeat_status()
+                            if monitor is not None else "ok")
                     if not np.isfinite(loss):
                         # failure detection: divergence gets a labeled exit
                         # (and the params snapshot when --save-dir is set)
