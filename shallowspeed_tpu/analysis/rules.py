@@ -413,6 +413,84 @@ def retrace(probe) -> list:
     return out
 
 
+# ---------------------------------------------------------- overlap-bucket
+
+
+@rule("overlap-bucket")
+def overlap_bucket(probe) -> list:
+    """Comm/compute-interleaving hygiene for programs registered as
+    overlapped (`parallel/overlap.register_program`):
+
+    - every grad-sized reduction (`psum`/`psum_scatter`/
+      `reduce_scatter`) over the registered data axis must match one of
+      the registered bucket signatures — a stray dp psum outside the
+      plan means some gradient bypasses the bucketed reduction (HIGH);
+    - every registered bucket must actually appear (MEDIUM — the plan
+      and the program drifted);
+    - the interleaving dataflow must exist: at least one collective on
+      the registered axis with independent MXU-heavy compute in its
+      scope, which is what XLA's latency-hiding scheduler needs to
+      overlap it (HIGH otherwise — the reduction is fully exposed and
+      "overlap" is a lie).
+
+    Sub-KiB reductions (health-pack statistics, loss means) are not
+    gradient traffic and are exempt. Unregistered programs are skipped
+    — the bulk reduction is the documented oracle, not a defect."""
+    from collections import Counter
+
+    from shallowspeed_tpu.parallel import overlap as OV
+
+    out = []
+    for ep in probe.entrypoints:
+        info = OV.registered(ep.fn)
+        if info is None:
+            continue
+        axis = info["axis"]
+        expected = Counter(info["buckets"])
+        seen: Counter = Counter()
+        for eqn, path, env in probe.walk(ep):
+            name = eqn.primitive.name
+            if name not in OV.REDUCE_PRIMS:
+                continue
+            if axis not in OV.eqn_axes(eqn):
+                continue
+            operands = [v for v in eqn.invars
+                        if not isinstance(v, jax.core.Literal)]
+            nbytes = sum(aval_bytes(v.aval) for v in operands)
+            sig = OV.bucket_signature([v.aval for v in operands])
+            if seen[sig] < expected[sig]:
+                seen[sig] += 1
+            elif nbytes < 1024:
+                continue  # unmatched scalar statistics (health pack,
+                #           loss means), not gradient payload
+            else:
+                out.append(Finding(
+                    "overlap-bucket", Severity.HIGH, probe.name,
+                    ep.name, path,
+                    f"{name} over '{axis}' ({nbytes} B, "
+                    f"{len(operands)} operand(s)) is not a registered "
+                    f"reduction bucket — this gradient bypasses the "
+                    f"bucketed overlapped reduction"))
+        missing = expected - seen
+        if missing:
+            out.append(Finding(
+                "overlap-bucket", Severity.MEDIUM, probe.name, ep.name,
+                (),
+                f"{sum(missing.values())} registered bucket(s) never "
+                f"appeared in the traced program — the bucket plan and "
+                f"the compiled reduction drifted"))
+        expo = OV.collective_exposure(probe.jaxpr_of(ep), axes=(axis,))
+        if expo["n_collectives"] and not expo["n_overlapped"]:
+            out.append(Finding(
+                "overlap-bucket", Severity.HIGH, probe.name, ep.name,
+                (),
+                f"no '{axis}' collective in this registered-overlapped "
+                f"program has independent compute in its scope — every "
+                f"reduction is a dataflow barrier and nothing can "
+                f"overlap"))
+    return out
+
+
 # ------------------------------------------------------- memory highwater
 
 

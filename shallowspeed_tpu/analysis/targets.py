@@ -166,6 +166,41 @@ def build_engine(budget: int = DEFAULT_BUDGET) -> TargetProbe:
     return probe.seal()
 
 
+def build_engine_overlap(budget: int = DEFAULT_BUDGET) -> TargetProbe:
+    """`engine.FusedDPEngine(overlap=...)` — the bucketed backward-
+    overlapped dp reduction. The `overlap-bucket` rule's live target:
+    proves every dp reduction is a registered bucket AND that the
+    bucket collectives are dataflow-interleaved with backward compute
+    (the acceptance shape for `parallel/overlap.py`)."""
+    from shallowspeed_tpu.engine import FusedDPEngine
+    from shallowspeed_tpu.models.mlp import MLPStage
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.mesh import make_mesh
+    from shallowspeed_tpu.parallel.overlap import OverlapConfig
+
+    sizes, gbs, n_mu, dp = [12, 16, 14, 10], 16, 2, 2
+    eng = FusedDPEngine(MLPStage(sizes, 0, 1, batch_size=gbs), SGD(0.1),
+                        make_mesh(dp, 1),
+                        overlap=OverlapConfig(bucket_mb=0.001))
+    ds = [_SynthDS(n_mu, gbs // dp // n_mu, sizes[0], sizes[-1], r)
+          for r in range(dp)]
+    for b in range(2):
+        eng.train_batch(b, ds)
+
+    probe = TargetProbe("engine:overlap", eng.mesh, None,
+                        hbm_budget=budget)
+    xs, ys = (jax.ShapeDtypeStruct((dp, n_mu, gbs // dp // n_mu, d),
+                                   np.float32)
+              for d in (sizes[0], sizes[-1]))
+    probe.entrypoints = [
+        EntryPoint("_step", eng._step,
+                   (_sds(eng.params), _sds(eng.opt_state), xs, ys),
+                   ("params", "opt_state", "xs", "ys"),
+                   donate=(0, 1), calls=2),
+    ]
+    return probe.seal()
+
+
 def build_spmd_pipeline(budget: int = DEFAULT_BUDGET) -> TargetProbe:
     """`parallel.SPMDPipelineEngine` — the compiled GPipe MLP step."""
     from shallowspeed_tpu.optim import SGD
@@ -294,6 +329,7 @@ def build_pipeline_lm(schedule: str = "gpipe", virtual_pp: int = 1,
 
 TARGET_BUILDERS: dict[str, Callable] = {
     "engine": build_engine,
+    "engine:overlap": build_engine_overlap,
     "spmd_pipeline": build_spmd_pipeline,
     "gspmd": build_gspmd,
     "pipeline_lm:gpipe": lambda budget=DEFAULT_BUDGET:

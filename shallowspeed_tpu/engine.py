@@ -4,11 +4,23 @@ The pipeline VM (`parallel/worker.py`) interprets instruction streams with one
 dispatch per instruction, mirroring the reference's executor
 (`/root/reference/shallowspeed/pipe.py:434-466`). For dp×1 topologies the
 whole batch step can instead be **one** jitted XLA program: `lax.scan` over
-the microbatch stack (grad accumulation, `layers.py:135-136` semantics),
-`lax.psum` of the accumulated grads over the 'dp' mesh axis (replacing the
-interleaved `Iallreduce`/`Waitall`, `pipe.py:302-327` — XLA's latency-hiding
-scheduler overlaps the collective with compute), and the optimizer update —
-zero Python dispatch inside the step, which is what the TPU wants.
+the microbatch stack (grad accumulation, `layers.py:135-136` semantics), the
+DP reduction over the 'dp' mesh axis, and the optimizer update — zero Python
+dispatch inside the step, which is what the TPU wants.
+
+The DP reduction has two modes. The default (the oracle) is the bulk
+reduction: per-leaf `lax.psum` of the fully accumulated grads AFTER the
+microbatch scan — and because the scan is a single dataflow node, every
+byte of that reduction is *exposed* (there is no independent compute
+left for XLA's latency-hiding scheduler to hide it under). With
+`overlap=OverlapConfig(...)` the engine instead peels the last
+microbatch out of the scan and interleaves size-targeted bucket psums
+into its hand-written layer-by-layer backward
+(`parallel/overlap.bucketed_stage_backward`) — the compiled equivalent
+of the reference's per-parameter `Iallreduce` hooks interleaving
+reduction of layer i with the backward of layer i-1
+(`pipe.py:302-327`). Same math, same wire bytes, strictly lower
+exposed-communication fraction (telemetry's `exposed_comm_frac`).
 
 Sequential training (`--dp 1 --pp 1`, reference `train.py:62-155` with no
 flags) is the dp=1 special case.
@@ -51,13 +63,14 @@ class FusedDPEngine:
     """
 
     def __init__(self, stage: MLPStage, optimizer, mesh: Mesh,
-                 health: str = "off"):
+                 health: str = "off", overlap=None):
         from shallowspeed_tpu.telemetry.health import MODES
 
         assert stage.n_stages == 1
         assert health in MODES, health
         self.health = health
         self.last_health = None
+        self.overlap = overlap  # parallel.overlap.OverlapConfig | None
         self.stage = stage
         self.optimizer = optimizer
         # accept a (dp, 1) 2-D mesh or a 1-D ('dp',) mesh
@@ -75,13 +88,33 @@ class FusedDPEngine:
         stage_ref = self.stage
         opt_ref = self.optimizer
 
+        # bucket plan for the overlapped reduction: the stage's leaves
+        # in backward-finalization order, partitioned by target bytes
+        if overlap is not None:
+            from shallowspeed_tpu.parallel import overlap as OV
+
+            order = OV.mlp_leaf_order(self.params)
+            raw = OV.plan_buckets([l for _, l in order],
+                                  overlap.bucket_bytes)
+            ov_plan = [[order[j][0] for j in b] for b in raw]
+            leaf_by_id = dict(order)
+            self._bucket_sigs = [
+                OV.bucket_signature([leaf_by_id[i] for i in b])
+                for b in ov_plan]
+        else:
+            ov_plan = None
+            self._bucket_sigs = []
+
         def batch_grads(params, x_mu, y_mu):
             """The ONE encoding of the per-device gradient computation
             on (n_mu, mubs, d) microbatch stacks: grad-accumulating
             scan over microbatches (`layers.py:135-136` semantics),
-            one bucketed psum over 'dp' (`pipe.py:302-327` equivalent).
-            Shared by the plain and health-instrumented steps so the
-            two can never train differently."""
+            then the DP reduction — per-leaf bulk psums after the scan
+            (the oracle), or, with `overlap`, bucket psums interleaved
+            into the peeled last microbatch's layer-by-layer backward
+            (`pipe.py:302-327` equivalent). Shared by the plain and
+            health-instrumented steps so the two can never train
+            differently."""
 
             def mu_body(acc, xy):
                 x, y = xy
@@ -92,8 +125,22 @@ class FusedDPEngine:
             # the zero init is axis-invariant but the accumulated grads vary
             # per dp shard — cast the carry to varying for shard_map's typing
             acc0 = _pvary(zero_grads_like(params), ("dp",))
-            acc, _ = jax.lax.scan(mu_body, acc0, (x_mu, y_mu))
-            return tree_map(lambda g: jax.lax.psum(g, "dp"), acc)
+            if ov_plan is None:
+                acc, _ = jax.lax.scan(mu_body, acc0, (x_mu, y_mu))
+                return tree_map(lambda g: jax.lax.psum(g, "dp"), acc)
+            from shallowspeed_tpu.parallel.overlap import (
+                bucketed_stage_backward)
+
+            # peel the last microbatch: the first n_mu-1 accumulate in
+            # the scan (unreduced); the peeled backward finalizes each
+            # leaf's total and psums each bucket as soon as its leaves
+            # are final — interleaved with the remaining backward
+            acc, _ = jax.lax.scan(mu_body, acc0,
+                                  (x_mu[:-1], y_mu[:-1]))
+            _, stash = stage_ref.forward(params, x_mu[-1])
+            return bucketed_stage_backward(
+                stage_ref, params, stash, y_mu[-1], acc, ov_plan,
+                ("dp",))
 
         def local_step(params, opt_state, x_mu, y_mu):
             """batch_grads + optimizer update (the _epoch/_run body)."""
@@ -165,12 +212,22 @@ class FusedDPEngine:
                     epoch_body, (params, opt_state), None, length=n_epochs)
                 return params, opt_state
 
+            if overlap is not None:
+                from shallowspeed_tpu.parallel import overlap as OV
+
+                OV.register_program(_run, "dp", self._bucket_sigs,
+                                    engine="FusedDPEngine")
             return _run
 
         self._step = _step
         self._infer = _infer
         self._make_run = _make_run
         self._run_cache: dict[int, Any] = {}
+        if overlap is not None:
+            from shallowspeed_tpu.parallel import overlap as OV
+
+            OV.register_program(_step, "dp", self._bucket_sigs,
+                                engine="FusedDPEngine")
 
     # ------------------------------------------------------------- steps
 

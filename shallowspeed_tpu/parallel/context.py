@@ -74,7 +74,7 @@ class ContextParallelEngine:
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  seed: int = 0, attn: str = "ring", zero1: bool = False,
                  zero2: bool = False, accum: int = 1,
-                 health: str = "off"):
+                 health: str = "off", overlap=None):
         from shallowspeed_tpu.telemetry.health import MODES
 
         assert mesh.axis_names == ("dp", "sp")
@@ -83,6 +83,7 @@ class ContextParallelEngine:
         assert health in MODES, health
         self.health = health
         self.last_health = None
+        self.overlap = overlap  # parallel.overlap.OverlapConfig | None
         self.accum = accum
         self.cfg = cfg
         self.mesh = mesh
@@ -158,22 +159,28 @@ class ContextParallelEngine:
         n_tiles = self.dp * self.sp
         accum = self.accum
 
-        def partial_grad_sum(params_v, tokens, targets, key):
-            """Gradient accumulation: scan `accum` microbatches of the
-            local tile, each doing its own forward AND backward (the
-            standard JAX pattern — no cross-iteration residuals, so
-            activation memory is one microbatch's worth regardless of
-            accum). `params_v` must be pvaried so per-microbatch
-            cotangents stay UNREDUCED per-tile partials; the caller
-            places the single cross-tile reduction after the scan.
-            Returns (loss sum over microbatches, grad sum)."""
+        def mu_split(tokens, targets):
+            """(b, t) local tile -> (accum, b/accum, t) microbatch
+            stacks."""
             b, t = tokens.shape
             assert b % accum == 0, (
                 f"--accum {accum} must divide the per-device batch rows "
                 f"({b} here = batch / dp; sp shards the sequence dim, "
                 f"not rows)")
-            tok_r = tokens.reshape(accum, b // accum, t)
-            tgt_r = targets.reshape(accum, b // accum, t)
+            return (tokens.reshape(accum, b // accum, t),
+                    targets.reshape(accum, b // accum, t))
+
+        def partial_grad_sum(params_v, tok_r, tgt_r, key):
+            """Gradient accumulation: scan the given microbatch stack
+            of the local tile, each microbatch doing its own forward
+            AND backward (the standard JAX pattern — no cross-iteration
+            residuals, so activation memory is one microbatch's worth
+            regardless of accum). `params_v` must be pvaried so
+            per-microbatch cotangents stay UNREDUCED per-tile partials;
+            the caller places the cross-tile reduction after the scan
+            (or folds the returned sum into the peeled last
+            microbatch's in-backward bucket reduction — the overlapped
+            path). Returns (loss sum over microbatches, grad sum)."""
 
             def body(carry, xs):
                 mu, tok_mu, tgt_mu = xs
@@ -191,7 +198,7 @@ class ContextParallelEngine:
                           params_v)),
                 ("dp", "sp"))
             (loss_sum, gsum), _ = jax.lax.scan(
-                body, init, (jnp.arange(accum), tok_r, tgt_r))
+                body, init, (jnp.arange(tok_r.shape[0]), tok_r, tgt_r))
             return loss_sum, gsum
 
         def tile_loss_and_gsum(params_v, tokens, targets, key):
@@ -211,7 +218,8 @@ class ContextParallelEngine:
                         params_v)
                 return (jax.lax.pmean(lloc, ("dp", "sp")), gsum,
                         1.0 / n_tiles)
-            loss_sum, gsum = partial_grad_sum(params_v, tokens, targets,
+            tok_r, tgt_r = mu_split(tokens, targets)
+            loss_sum, gsum = partial_grad_sum(params_v, tok_r, tgt_r,
                                               key)
             return (jax.lax.pmean(loss_sum / accum, ("dp", "sp")), gsum,
                     1.0 / (n_tiles * accum))
@@ -223,6 +231,61 @@ class ContextParallelEngine:
             grads = tree_map(
                 lambda g: jax.lax.psum(g, ("dp", "sp")) * scale, gsum)
             return loss, grads
+
+        # ---- overlapped gradient programs (parallel/overlap.py): the
+        # cross-tile reduction moves INSIDE the backward, one bucket at
+        # a time. With accum > 1 the last microbatch is peeled out of
+        # the accumulation scan (a scan is one dataflow node — every
+        # reduction after it is exposed) and the earlier microbatches'
+        # unreduced sum is folded into each bucket's psum, so wire
+        # bytes match the bulk path exactly.
+        if overlap is not None:
+            from shallowspeed_tpu.parallel import overlap as OV
+
+            ov_plan, _p_leaves, _ = OV.plan_param_buckets(
+                self.params, overlap.bucket_bytes)
+            self._bucket_sigs = [
+                OV.bucket_signature([_p_leaves[i] for i in bk])
+                for bk in ov_plan]
+
+            def tagged_loss_and_gsum(params_v, tokens, targets, key,
+                                     tag):
+                """tile_loss_and_gsum with the reduction tags applied
+                to the (peeled) last microbatch's params: returns
+                (pmean'd loss, REDUCED grad sum, scale)."""
+                if accum == 1:
+                    lloc, gsum = jax.value_and_grad(
+                        lambda p: local_loss(tag(p, None), tokens,
+                                             targets, key))(params_v)
+                    return (jax.lax.pmean(lloc, ("dp", "sp")), gsum,
+                            1.0 / n_tiles)
+                tok_r, tgt_r = mu_split(tokens, targets)
+                loss_head, acc = partial_grad_sum(
+                    params_v, tok_r[:-1], tgt_r[:-1], key)
+                k_last = (None if key is None
+                          else jax.random.fold_in(key, accum - 1))
+                l_last, gsum = jax.value_and_grad(
+                    lambda p: local_loss(tag(p, acc), tok_r[-1],
+                                         tgt_r[-1], k_last))(params_v)
+                return (jax.lax.pmean((loss_head + l_last) / accum,
+                                      ("dp", "sp")),
+                        gsum, 1.0 / (n_tiles * accum))
+
+            def loss_and_grads_ov(params, tokens, targets, step):
+                def tag(p, acc):
+                    return OV.reduce_grads_on_backward(
+                        p, ("dp", "sp"), ov_plan, acc=acc)
+
+                loss, gsum, scale = tagged_loss_and_gsum(
+                    pvary_over(params, ("dp", "sp")), tokens, targets,
+                    train_key(step), tag)
+                return loss, tree_map(lambda g: g * scale, gsum)
+
+            lag = loss_and_grads_ov
+        else:
+            ov_plan = None
+            self._bucket_sigs = []
+            lag = loss_and_grads
 
         health_mode = health
 
@@ -268,22 +331,46 @@ class ContextParallelEngine:
                 # PARTIALS (no auto-psum), and the reduction is ours to
                 # place — psum_scatter over 'dp'
                 key = train_key(step)
-                loss, grads, gscale = tile_loss_and_gsum(
-                    pvary_over(params, ("dp", "sp")), tokens, targets,
-                    key)
-                leaves, tdef = jax.tree_util.tree_flatten(grads)
-                out = []
-                for g, dim in zip(leaves, gdims):
-                    # unconditionally: even at sp=1 the pvaried grads are
-                    # TYPED sp-varying and need the (free) psum to retype
-                    g = jax.lax.psum(g, "sp")
-                    if dim is None:
-                        g = jax.lax.psum(g, "dp")
-                    else:
-                        g = jax.lax.psum_scatter(
-                            g, "dp", scatter_dimension=dim, tiled=True)
-                    out.append(g * gscale)
-                grads = jax.tree_util.tree_unflatten(tdef, out)
+                if ov_plan is not None:
+                    # overlapped: the scatter tags emit each leaf's
+                    # psum_scatter INSIDE the backward (embedded at the
+                    # local shard slot — sliced back out below), with
+                    # the peeled-scan accumulator folded in; same wire
+                    # bytes, reduction interleaved with the backward
+                    from shallowspeed_tpu.parallel.overlap import (
+                        scatter_grads_on_backward, take_local_shard)
+
+                    def tag(p, acc):
+                        return scatter_grads_on_backward(
+                            p, "dp", gdims, ov_plan, acc=acc,
+                            extra_axes=("sp",))
+
+                    loss, grads, gscale = tagged_loss_and_gsum(
+                        pvary_over(params, ("dp", "sp")), tokens,
+                        targets, key, tag)
+                    leaves, tdef = jax.tree_util.tree_flatten(grads)
+                    grads = jax.tree_util.tree_unflatten(tdef, [
+                        take_local_shard(g, dim, "dp") * gscale
+                        for g, dim in zip(leaves, gdims)])
+                else:
+                    loss, grads, gscale = tile_loss_and_gsum(
+                        pvary_over(params, ("dp", "sp")), tokens,
+                        targets, key)
+                    leaves, tdef = jax.tree_util.tree_flatten(grads)
+                    out = []
+                    for g, dim in zip(leaves, gdims):
+                        # unconditionally: even at sp=1 the pvaried
+                        # grads are TYPED sp-varying and need the
+                        # (free) psum to retype
+                        g = jax.lax.psum(g, "sp")
+                        if dim is None:
+                            g = jax.lax.psum(g, "dp")
+                        else:
+                            g = jax.lax.psum_scatter(
+                                g, "dp", scatter_dimension=dim,
+                                tiled=True)
+                        out.append(g * gscale)
+                    grads = jax.tree_util.tree_unflatten(tdef, out)
                 if health_mode == "off":
                     return loss, grads
                 return loss, grads, maybe_pack(params, grads, gspecs)
@@ -307,9 +394,10 @@ class ContextParallelEngine:
             def _loss_grads(params, tokens, targets, step):
                 # ZeRO-1 grad program: the grads leave the shard_map
                 # already psum'd (invariant), ready for the dp-sharded
-                # optimizer update.
-                loss, grads = loss_and_grads(params, tokens, targets,
-                                             step)
+                # optimizer update (`lag`: bulk psums after the
+                # accumulation, or in-backward bucket psums with
+                # `overlap` — same contract either way).
+                loss, grads = lag(params, tokens, targets, step)
                 if health_mode == "off":
                     return loss, grads
                 return loss, grads, maybe_pack(params, grads)
@@ -330,7 +418,7 @@ class ContextParallelEngine:
                                P()),
                      out_specs=step_out)
             def _step(params, opt_state, tokens, targets, step):
-                loss, grads = loss_and_grads(params, tokens, targets, step)
+                loss, grads = lag(params, tokens, targets, step)
                 if health_mode == "off":
                     params, opt_state = opt.step(params, grads,
                                                  opt_state)
@@ -367,7 +455,7 @@ class ContextParallelEngine:
                 def body(carry, xs):
                     params, opt_state, step = carry
                     tok, tgt = xs
-                    loss, grads = loss_and_grads(params, tok, tgt, step)
+                    loss, grads = lag(params, tok, tgt, step)
                     params, opt_state = opt.step(params, grads, opt_state)
                     return (params, opt_state, step + 1), loss
 
@@ -376,6 +464,21 @@ class ContextParallelEngine:
                 return params, opt_state, losses
 
             self._run_fn = _run
+
+        if overlap is not None:
+            from shallowspeed_tpu.parallel import overlap as OV
+
+            if zero2:
+                # the dp-axis binds here are per-leaf scatters/psums
+                # (the bucket-grouped psums run over 'sp' only)
+                self._bucket_sigs = [
+                    OV.bucket_signature([l])
+                    for l in jax.tree_util.tree_leaves(self.params)]
+            fns = ([self._loss_grads_fn] if self._step_fn is None
+                   else [self._step_fn, self._run_fn])
+            for fn in fns:
+                OV.register_program(fn, "dp", self._bucket_sigs,
+                                    engine="ContextParallelEngine")
 
         @jax.jit
         @partial(shard_map, mesh=mesh,

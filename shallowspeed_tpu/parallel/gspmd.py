@@ -40,20 +40,36 @@ class GSPMDEngine:
     # interchanges engine-agnostically as-is (checkpoint.py)
     canonical_opt_identity = True
 
+    # Explicit comm/compute overlap (parallel/overlap.py) needs named-
+    # axis collectives to place; a plain GSPMD program has none — its
+    # collectives are compiler-inserted and compiler-scheduled, which
+    # is exactly the reliance the FSDP subclass's overlapped shard_map
+    # step replaces. Subclasses that build one set this True.
+    supports_overlap = False
+
     def __init__(self, cfg: T.TransformerConfig, optimizer, mesh: Mesh,
                  seed: int = 0, zero1: bool = False, zero2: bool = False,
-                 health: str = "off"):
+                 health: str = "off", overlap=None):
         from shallowspeed_tpu.telemetry.health import MODES
 
         assert not (zero1 and zero2), "zero2 subsumes zero1"
         assert health in MODES, health
+        if overlap is not None and not self.supports_overlap:
+            raise ValueError(
+                f"{type(self).__name__} is GSPMD-partitioned — its "
+                f"collectives are compiler-inserted and cannot be "
+                f"bucketed explicitly; --overlap supports the fsdp, "
+                f"context (dense/zero1/zero2), fused-dp, and spmd "
+                f"pipeline engines")
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
         self.health = health
         self.last_health = None
+        self.overlap = overlap  # parallel.overlap.OverlapConfig | None
         self.validate(cfg, mesh)
         self.dp = mesh.devices.shape[0]
+        self._seed = seed
 
         # one host-side init; exposed to param_specs so shape-dependent
         # placements (FSDP) don't re-run it

@@ -133,12 +133,13 @@ class SPMDPipelineEngine:
 
     def __init__(self, sizes, optimizer, mesh: Mesh, n_mubatches: int,
                  mubatch_size: int, global_batch_size: int,
-                 health: str = "off"):
+                 health: str = "off", overlap=None):
         from shallowspeed_tpu.telemetry.health import MODES
 
         assert health in MODES, health
         self.health = health
         self.last_health = None
+        self.overlap = overlap  # parallel.overlap.OverlapConfig | None
         assert mesh.axis_names == ("dp", "pp")
         self.mesh = mesh
         self.dp, self.pp = mesh.devices.shape
@@ -212,33 +213,82 @@ class SPMDPipelineEngine:
                      "probs": probs}
             return out, stash
 
-        def stage_bwd(W, valid, relu_f, dout, stash, is_last, target):
-            """One stage's padded backward; returns (dx, dW, db)."""
-            probs = stash["probs"]
-            # MSELoss head: target -> upstream grad (`layers.py:157-163`),
-            # then softmax VJP expressed via probs.
+        def head_grad(probs, target, dout, is_last):
+            """MSELoss head: target -> upstream grad
+            (`layers.py:157-163`), then softmax VJP expressed via
+            probs; non-last stages pass `dout` through. The ONE
+            encoding shared by the scanned backward tick and the
+            peeled bucketed replay."""
             g0 = -2.0 * (target - probs) / gbs
             gg = probs * g0
             d_head = gg - probs * gg.sum(axis=-1, keepdims=True)
-            d = jnp.where(is_last, d_head, dout)
+            return jnp.where(is_last, d_head, dout)
+
+        def bwd_layer(W, valid, relu_f, stash, l, d):
+            """One layer's padded backward step: (d_next, dW_l, db_l).
+            Padding layers are identity (gradient passes through, zero
+            weight grads). Shared by stage_bwd and the peeled replay so
+            the overlapped path can never diverge from the oracle's
+            math."""
+            d_in = d
+            d_act = jnp.where(relu_f[l] > 0,
+                              jnp.where(stash["masks"][l], d, 0.0), d)
+            dW = d_act.T @ stash["xs"][l]
+            db = d_act.sum(axis=0, keepdims=True)
+            d_prev = d_act @ W[l]
+            d = jnp.where(valid[l] > 0, d_prev, d_in)
+            return (d, jnp.where(valid[l] > 0, dW, 0.0),
+                    jnp.where(valid[l] > 0, db, 0.0))
+
+        def stage_bwd(W, valid, relu_f, dout, stash, is_last, target):
+            """One stage's padded backward; returns (dx, dW, db)."""
+            d = head_grad(stash["probs"], target, dout, is_last)
             dWs, dbs = [], []
             for l in range(L - 1, -1, -1):
-                d_in = d
-                d_act = jnp.where(relu_f[l] > 0,
-                                  jnp.where(stash["masks"][l], d, 0.0), d)
-                dW = d_act.T @ stash["xs"][l]
-                db = d_act.sum(axis=0, keepdims=True)
-                d_prev = d_act @ W[l]
-                # padding layers are identity: gradient passes through
-                d = jnp.where(valid[l] > 0, d_prev, d_in)
-                dWs.append(jnp.where(valid[l] > 0, dW, 0.0))
-                dbs.append(jnp.where(valid[l] > 0, db, 0.0))
+                d, dW, db = bwd_layer(W, valid, relu_f, stash, l, d)
+                dWs.append(dW)
+                dbs.append(db)
             dWs.reverse()
             dbs.reverse()
             return d, jnp.stack(dWs), jnp.stack(dbs)
 
-        fwd_ticks = n_mu + pp - 1
-        bwd_ticks = n_mu + pp - 1
+        # Comm/compute interleaving (parallel/overlap.py). Two opt-in
+        # pieces share the `overlap` config:
+        # - double-buffered p2p hops (stride 2): each tick permutes the
+        #   PREVIOUS tick's output while computing the current one, so
+        #   the hop leaves the per-tick critical path (single-buffer
+        #   ticks serialize compute -> ppermute -> next compute). Costs
+        #   pp-1 extra warmup/drain ticks: microbatch m sits at stage s
+        #   at tick stride*s + m.
+        # - bucketed dp reduction: the final backward tick is peeled
+        #   out of the scan and its layer loop emits each grad bucket's
+        #   psum the moment the bucket's leaves are final — interleaved
+        #   with the remaining backward instead of one exposed bulk
+        #   reduction after the scan.
+        ov = self.overlap
+        stride = 2 if (ov is not None and ov.double_buffer_hops) else 1
+        if ov is not None:
+            from shallowspeed_tpu.parallel import overlap as OVM
+
+            order = []
+            for l in range(L - 1, -1, -1):  # backward-finalization order
+                order.append((2 * l, jax.ShapeDtypeStruct(
+                    (self.wmax, self.wmax), jnp.float32)))
+                order.append((2 * l + 1, jax.ShapeDtypeStruct(
+                    (1, self.wmax), jnp.float32)))
+            raw = OVM.plan_buckets([x for _, x in order],
+                                   ov.bucket_bytes)
+            ov_plan = [[order[j][0] for j in bk] for bk in raw]
+            by_id = dict(order)
+            self._bucket_sigs = [
+                OVM.bucket_signature([by_id[i] for i in bk])
+                for bk in ov_plan]
+        else:
+            ov_plan = None
+            self._bucket_sigs = []
+
+        fwd_ticks = n_mu + stride * (pp - 1)
+        bwd_ticks = n_mu + stride * (pp - 1)
 
         def local_step(params, opt_state, xs, ys):
             """Per-device GPipe batch step.
@@ -255,9 +305,8 @@ class SPMDPipelineEngine:
             xs, ys = xs[0], ys[0]
 
             # ---------------- forward phase
-            def fwd_tick(carry, t):
-                cur, stashes = carry
-                m = t - s  # microbatch this stage handles at tick t
+            def fwd_compute(cur, stashes, t):
+                m = t - stride * s  # microbatch this stage handles at t
                 active = (m >= 0) & (m < n_mu)
                 mc = jnp.clip(m, 0, n_mu - 1)
                 x_own = jax.lax.dynamic_index_in_dim(xs, mc, keepdims=False)
@@ -268,24 +317,46 @@ class SPMDPipelineEngine:
                     newb = jax.lax.dynamic_update_index_in_dim(buf, new, mc, 0)
                     return jnp.where(active, newb, buf)
 
-                stashes = tree_map(upd, stashes, stash)
+                return out, tree_map(upd, stashes, stash)
+
+            def fwd_tick(carry, t):
+                # single-buffer: compute, then hop this tick's output
+                # (the next tick's compute waits on the permute)
+                cur, stashes = carry
+                out, stashes = fwd_compute(cur, stashes, t)
                 nxt = jax.lax.ppermute(out, "pp", right)
                 return (nxt, stashes), None
+
+            def fwd_tick_db(carry, t):
+                # double-buffered: hop the PREVIOUS tick's output while
+                # computing this tick's — the ppermute and the matmuls
+                # share no dataflow, so the latency-hiding scheduler
+                # runs them concurrently (delivery takes two ticks,
+                # hence the stride-2 microbatch placement)
+                cur, inflight, stashes = carry
+                recv = jax.lax.ppermute(inflight, "pp", right)
+                out, stashes = fwd_compute(cur, stashes, t)
+                return (recv, out, stashes), None
 
             stash0 = {
                 "xs": jnp.zeros((n_mu, L, mubs, wmax)),
                 "masks": jnp.zeros((n_mu, L, mubs, wmax), bool),
                 "probs": jnp.zeros((n_mu, mubs, wmax)),
             }
-            init = _pvary((jnp.zeros((mubs, wmax)), stash0), ("pp", "dp"))
-            (cur, stashes), _ = jax.lax.scan(
-                fwd_tick, init, jnp.arange(fwd_ticks))
+            zblk = jnp.zeros((mubs, wmax))
+            if stride == 1:
+                init = _pvary((zblk, stash0), ("pp", "dp"))
+                (cur, stashes), _ = jax.lax.scan(
+                    fwd_tick, init, jnp.arange(fwd_ticks))
+            else:
+                init = _pvary((zblk, zblk, stash0), ("pp", "dp"))
+                (cur, _, stashes), _ = jax.lax.scan(
+                    fwd_tick_db, init, jnp.arange(fwd_ticks))
 
             # ---------------- backward phase (reversed microbatch order,
             # GPipe `pipe.py:234-235`; the last stage leads)
-            def bwd_tick(carry, t):
-                cur, gW, gb = carry
-                r = t - (pp - 1 - s)      # reversed index handled at tick t
+            def bwd_mu_stash(t):
+                r = t - stride * (pp - 1 - s)  # reversed index at tick t
                 m = n_mu - 1 - r
                 active = (r >= 0) & (r < n_mu)
                 mc = jnp.clip(m, 0, n_mu - 1)
@@ -297,23 +368,73 @@ class SPMDPipelineEngine:
                 # probs, so the head grad on padding is exactly zero.
                 y_own = jax.lax.dynamic_index_in_dim(ys, mc, keepdims=False)
                 y_own = jnp.pad(y_own, ((0, 0), (0, wmax - y_own.shape[-1])))
+                return active, stash_m, y_own
+
+            def bwd_compute(cur, gW, gb, t):
+                active, stash_m, y_own = bwd_mu_stash(t)
                 dx, dW, db = stage_bwd(W, valid, relu_f, cur, stash_m,
                                        is_last, y_own)
                 gW = gW + jnp.where(active, dW, 0.0)
                 gb = gb + jnp.where(active, db, 0.0)
-                dx = jnp.where(active, dx, 0.0)
+                return jnp.where(active, dx, 0.0), gW, gb
+
+            def bwd_tick(carry, t):
+                cur, gW, gb = carry
+                dx, gW, gb = bwd_compute(cur, gW, gb, t)
                 nxt = jax.lax.ppermute(dx, "pp", left)
                 return (nxt, gW, gb), None
 
-            binit = _pvary((jnp.zeros((mubs, wmax)), jnp.zeros_like(W),
-                            jnp.zeros_like(b)), ("pp", "dp"))
-            (_, gW, gb), _ = jax.lax.scan(
-                bwd_tick, binit, jnp.arange(bwd_ticks))
+            def bwd_tick_db(carry, t):
+                cur, inflight, gW, gb = carry
+                recv = jax.lax.ppermute(inflight, "pp", left)
+                dx, gW, gb = bwd_compute(cur, gW, gb, t)
+                return (recv, dx, gW, gb), None
 
-            # ---------------- DP all-reduce + optimizer: one bucketed psum
-            # over 'dp' (`pipe.py:302-327` equivalent)
-            grads = {"W": jax.lax.psum(gW, "dp")[None],
-                     "b": jax.lax.psum(gb, "dp")[None]}
+            # with a bucket plan the final tick is peeled out of the
+            # scan so its layer loop can interleave the dp reduction
+            n_scan = bwd_ticks - (1 if ov_plan is not None else 0)
+            if stride == 1:
+                binit = _pvary((zblk, jnp.zeros_like(W),
+                                jnp.zeros_like(b)), ("pp", "dp"))
+                (cur, gW, gb), _ = jax.lax.scan(
+                    bwd_tick, binit, jnp.arange(n_scan))
+            else:
+                binit = _pvary((zblk, zblk, jnp.zeros_like(W),
+                                jnp.zeros_like(b)), ("pp", "dp"))
+                (cur, _, gW, gb), _ = jax.lax.scan(
+                    bwd_tick_db, binit, jnp.arange(n_scan))
+
+            if ov_plan is None:
+                # bulk oracle: one psum per stacked leaf AFTER the scan
+                # — fully exposed (the scan is its only producer), kept
+                # as the reduction-order reference (`pipe.py:302-327`)
+                grads = {"W": jax.lax.psum(gW, "dp")[None],
+                         "b": jax.lax.psum(gb, "dp")[None]}
+            else:
+                from shallowspeed_tpu.parallel.overlap import (
+                    BucketEmitter)
+
+                # peeled final backward tick (only stage 0 is still
+                # active — every other stage's grads are already
+                # final): replay stage_bwd's layer loop and emit each
+                # bucket's psum the moment its layers' totals are
+                # final, dataflow-independent of the earlier layers'
+                # backward matmuls still being computed.
+                t_last = bwd_ticks - 1
+                active, stash_m, y_own = bwd_mu_stash(t_last)
+                d = head_grad(stash_m["probs"], y_own, cur, is_last)
+                em = BucketEmitter(ov_plan, ("dp",))
+                for l in range(L - 1, -1, -1):
+                    d, dW_l, db_l = bwd_layer(W, valid, relu_f,
+                                              stash_m, l, d)
+                    em.add(2 * l, gW[l] + jnp.where(active, dW_l, 0.0))
+                    em.add(2 * l + 1,
+                           gb[l] + jnp.where(active, db_l, 0.0))
+                red = em.done()
+                grads = {
+                    "W": jnp.stack([red[2 * l] for l in range(L)])[None],
+                    "b": jnp.stack([red[2 * l + 1]
+                                    for l in range(L)])[None]}
             if health_mode == "off":
                 return opt.step(params, grads, opt_state)
             # health pack fused into the step (telemetry/health.py):
@@ -396,6 +517,12 @@ class SPMDPipelineEngine:
         self._step_fn = _step
         self._epoch_fn = _epoch
         self._infer_fn = _infer
+        if ov is not None:
+            from shallowspeed_tpu.parallel import overlap as OVM
+
+            for fn in (_step, _epoch):
+                OVM.register_program(fn, "dp", self._bucket_sigs,
+                                     engine="SPMDPipelineEngine")
 
     # ------------------------------------------------------------- data
 
@@ -463,9 +590,14 @@ class SPMDPipelineEngine:
 
     def schedule_info(self) -> dict:
         """Executed-schedule identity for bubble accounting: this
-        engine IS the compiled GPipe tick program."""
+        engine IS the compiled GPipe tick program. With double-buffered
+        hops the stage spacing is 2 ticks (microbatch m sits at stage s
+        at tick 2s+m), trading pp-1 extra warmup/drain ticks for hops
+        off the per-tick critical path."""
+        db = bool(self.overlap is not None
+                  and self.overlap.double_buffer_hops)
         return {"schedule": "gpipe", "n_mu": self.n_mu, "pp": self.pp,
-                "vpp": 1}
+                "vpp": 1, "hop_double_buffer": db}
 
     def health_snapshot(self) -> dict | None:
         """The last train_batch's health pack as a host dict (one

@@ -35,6 +35,19 @@ style (`zero2_grad_specs` serves both):
   propagation turns the all-reduce into reduce-scatter on its own.
 - shard_map engines: pvary the params so cotangents arrive as per-tile
   partials, then `lax.psum_scatter` each leaf over 'dp' explicitly.
+
+A third formulation composes with both stages (round 8,
+`parallel/overlap.py`): with `overlap=OverlapConfig(...)` the shard_map
+engines move the reduction INSIDE the backward — ZeRO-1 grads reduce
+through per-bucket psum tags, ZeRO-2 grads through per-leaf
+`psum_scatter` tags whose scatter dimension is read off
+`zero2_grad_dim` exactly like the bulk path, so the sharded update
+(`make_zero1_update`) sees an identical 1/dp grad layout whether the
+scatter ran after the accumulation scan (bulk oracle) or interleaved
+with the backward (overlapped). The leaf-alignment invariant this
+module encodes is therefore load-bearing for three reduction
+schedules, and `tests/test_overlap.py` pins all of them against the
+dense oracle.
 """
 
 from __future__ import annotations

@@ -143,7 +143,7 @@ class RunTelemetry:
         rep = {}
         for ep in eps:
             try:
-                # ONE make_jaxpr per entrypoint, shared by both
+                # ONE make_jaxpr per entrypoint, shared by all three
                 # accountings — tracing a big pipeline step costs
                 # seconds and must not run twice
                 import jax
@@ -156,8 +156,19 @@ class RunTelemetry:
             except Exception as e:
                 rep[ep["name"]] = {"error": repr(e)[:200]}
                 continue
+            try:
+                # exposure (schema v3) in its own guard: a failure in
+                # the newer dataflow walk must not discard the v1/v2
+                # traffic/HBM accounting (step_fields tolerates None)
+                from shallowspeed_tpu.parallel.overlap import (
+                    collective_exposure)
+
+                expo = collective_exposure(closed)
+            except Exception:
+                expo = None
             rep[ep["name"]] = {"collectives": traffic,
-                               "static_peak_bytes": peak}
+                               "static_peak_bytes": peak,
+                               "exposure": expo}
         self._static = {"entrypoints": rep,
                         "step": eps[0]["name"]}  # first = the step fn
         return self._static
@@ -214,6 +225,17 @@ class RunTelemetry:
                     gbps = (traffic["total_bytes"] * steps_in_window
                             / window_secs / 1e9)
                     out["coll_gbps"] = round(gbps, 6)
+            # schema v3: the step program's dataflow comm exposure
+            # (parallel/overlap.collective_exposure) — the fraction of
+            # collective bytes with no independent compute to hide
+            # under; absent for programs with no jaxpr-level
+            # collectives (GSPMD-inserted ones are invisible here)
+            expo = step_ep.get("exposure")
+            if expo and expo.get("exposed_comm_frac") is not None:
+                out["exposed_comm_frac"] = expo["exposed_comm_frac"]
+                out["overlap_ratio"] = expo["overlap_ratio"]
+                out["overlap"] = bool(getattr(self.engine, "overlap",
+                                              None))
         measured = getattr(self.engine, "telemetry_traffic", None)
         if measured is not None:
             out["coll_bytes_measured"] = measured()

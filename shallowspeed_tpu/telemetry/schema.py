@@ -23,12 +23,16 @@ from pathlib import Path
 
 # Version of the line dialects this module describes. 1 = PR-2 (spans +
 # hardware telemetry step fields); 2 = PR-2 plus the training-health
-# extension (health_* step fields, the "health" event). Writers stamp
-# it on their run_start line (metrics.MetricsLogger); the validator
-# accepts BOTH dialects — every health field is optional, so committed
-# round-2 artifacts (no version stamp, no health fields) keep
-# validating unchanged.
-SCHEMA_VERSION = 2
+# extension (health_* step fields, the "health" event); 3 = v2 plus
+# the comm/compute-overlap fields (`exposed_comm_frac` /
+# `overlap_ratio` — the step program's dataflow communication
+# exposure, `parallel/overlap.collective_exposure` — and the engine's
+# `overlap` mode flag). Writers stamp it on their run_start line
+# (metrics.MetricsLogger); the validator accepts ALL dialects — every
+# versioned field is optional, so committed v1/v2 artifacts (no
+# version stamp / no health / no overlap fields) keep validating
+# unchanged.
+SCHEMA_VERSION = 3
 
 _NUM = (int, float)
 
@@ -58,6 +62,8 @@ _STEP_TELEMETRY = {
     "health_update_ratio": _NUM, "health_nonfinite": int,
     "health_skipped_total": int, "health_verdicts": list,
     "health_groups": dict,
+    # --- schema v3: comm/compute-overlap fields (parallel/overlap.py)
+    "exposed_comm_frac": _NUM, "overlap_ratio": _NUM, "overlap": bool,
 }
 
 _SPAN_PH = {"X", "i", "C"}

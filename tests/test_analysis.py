@@ -244,6 +244,105 @@ def test_suppression_requires_reason():
         suppress("donation", reason="   ")
 
 
+# ---------------------------------------------------------- overlap-bucket
+
+
+def dp_mesh2():
+    return Mesh(np.array(jax.devices()[:2]), ("dp",))
+
+
+def _grad_psum_program(extra_stray=False):
+    """Toy overlapped-style grad program: two dot 'layers', per-bucket
+    psums interleaved so each has independent compute. With
+    `extra_stray`, a third grad-sized dp psum is emitted that no
+    bucket registers."""
+    mesh = dp_mesh2()
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), P(), P("dp")), out_specs=(P(), P(), P()))
+    def step(w1, w2, x):
+        h = x @ w1
+        g2 = jax.lax.psum((h.T @ h,), "dp")[0]       # bucket: layer 2
+        g1 = jax.lax.psum((x.T @ (h @ w2),), "dp")[0]  # bucket: layer 1
+        stray = (jax.lax.psum(x.T @ x, "dp")
+                 if extra_stray else jnp.zeros_like(g1))
+        return g1, g2, stray
+
+    return step
+
+
+def _ov_probe(fn, register_buckets):
+    from shallowspeed_tpu.parallel.overlap import (bucket_signature,
+                                                   register_program)
+
+    register_program(
+        fn, "dp",
+        [bucket_signature([np.zeros((64, 64), np.float32)])
+         for _ in range(register_buckets)], engine="toy")
+    args = [sds((64, 64), jnp.float32), sds((64, 64), jnp.float32),
+            sds((8, 64), jnp.float32)]
+    return toy_probe(fn, args, mesh=dp_mesh2())
+
+
+def test_overlap_rule_fires_on_unregistered_dp_psum():
+    probe = _ov_probe(_grad_psum_program(extra_stray=True),
+                      register_buckets=2)
+    found = highs(run_rules(probe, only=("overlap-bucket",)))
+    assert found and "not a registered" in found[0].message
+
+
+def test_overlap_rule_quiet_on_registered_buckets():
+    probe = _ov_probe(_grad_psum_program(), register_buckets=2)
+    assert not run_rules(probe, only=("overlap-bucket",))
+
+
+def test_overlap_rule_fires_when_nothing_can_overlap():
+    mesh = dp_mesh2()
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P("dp")),
+             out_specs=P())
+    def barrier(w, x):
+        h = x @ w
+        return jax.lax.psum((h.T @ h,), "dp")[0]  # every dot feeds it
+
+    from shallowspeed_tpu.parallel.overlap import (bucket_signature,
+                                                   register_program)
+
+    register_program(barrier, "dp",
+                     [bucket_signature([np.zeros((64, 64),
+                                                 np.float32)])])
+    probe = toy_probe(barrier, [sds((64, 64), jnp.float32),
+                                sds((8, 64), jnp.float32)],
+                      mesh=dp_mesh2())
+    found = highs(run_rules(probe, only=("overlap-bucket",)))
+    assert found and "independent compute" in found[0].message
+
+
+def test_overlap_rule_flags_missing_registered_bucket():
+    probe = _ov_probe(_grad_psum_program(), register_buckets=3)
+    found = run_rules(probe, only=("overlap-bucket",))
+    assert any("never appeared" in f.message
+               and f.severity == Severity.MEDIUM for f in found)
+
+
+def test_overlap_rule_skips_unregistered_programs():
+    mesh = dp_mesh2()
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P("dp")),
+             out_specs=P())
+    def bulk(w, x):  # the documented bulk oracle — not a defect
+        h = x @ w
+        return jax.lax.psum(h.T @ h, "dp")
+
+    probe = toy_probe(bulk, [sds((64, 64), jnp.float32),
+                             sds((8, 64), jnp.float32)],
+                      mesh=dp_mesh2())
+    assert not run_rules(probe, only=("overlap-bucket",))
+
+
 # ----------------------------------------------- the tier-1 clean gate
 
 

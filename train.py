@@ -49,6 +49,17 @@ def parse_args(argv=None):
                    choices=["sgd", "momentum", "adam", "adamw"])
     p.add_argument("--grad-clip", type=float, default=0.0,
                    help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--overlap", default="off", choices=["off", "on"],
+                   help="comm/compute interleaving (shallowspeed_tpu."
+                        "parallel.overlap): bucketed dp gradient "
+                        "reduction issued inside the backward (fused "
+                        "engine) and double-buffered stage hops + the "
+                        "peeled bucketed reduction (spmd engine); the "
+                        "default bulk reduction is the oracle")
+    p.add_argument("--bucket-mb", type=float, default=4.0,
+                   help="with --overlap on: target bytes per reduction "
+                        "bucket (MiB); smaller = more, earlier "
+                        "collectives")
     p.add_argument("--weight-decay", type=float, default=0.01,
                    help="decoupled weight decay (adamw only)")
     p.add_argument("--data-dir", type=str, default="data/mnist_784")
@@ -177,16 +188,24 @@ def build(args):
         raise SystemExit("--engine spmd implements the gpipe schedule; use "
                          "--schedule gpipe (or --engine vm)")
 
+    from shallowspeed_tpu.parallel.overlap import from_flags
+
+    ov = from_flags(args.overlap, args.bucket_mb)
     if engine_kind == "fused":
         stage = MLPStage(LAYER_SIZES, 0, 1, batch_size=args.batch_size)
         engine = FusedDPEngine(stage, optimizer, mesh,
-                               health=args.health)
+                               health=args.health, overlap=ov)
     elif engine_kind == "spmd":
         engine = SPMDPipelineEngine(LAYER_SIZES, optimizer, mesh,
                                     args.mubatches, mubatch_size,
                                     args.batch_size,
-                                    health=args.health)
+                                    health=args.health, overlap=ov)
     else:
+        if ov is not None:
+            raise SystemExit(
+                "--overlap on needs a compiled engine (fused or spmd); "
+                "the instruction VM already issues its collectives "
+                "per-instruction")
         stages = [MLPStage(LAYER_SIZES, s, pp, batch_size=args.batch_size)
                   for s in range(pp)]
         engine = PipelineExecutor(mesh, stages, optimizer,

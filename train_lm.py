@@ -165,6 +165,20 @@ def parse_args(argv=None):
                    help="ZeRO-2: ZeRO-1 plus dp-sharded gradients — the "
                         "DP reduction becomes a reduce-scatter and the "
                         "persistent grad buffer is 1/dp per device")
+    p.add_argument("--overlap", default="off", choices=["off", "on"],
+                   help="comm/compute interleaving (shallowspeed_tpu."
+                        "parallel.overlap): the dp gradient reduction "
+                        "moves INSIDE the backward, one size-targeted "
+                        "bucket at a time (with --accum the last "
+                        "microbatch is peeled out of the accumulation "
+                        "scan); --fsdp gains explicit per-leaf "
+                        "all-gather prefetch + in-backward "
+                        "reduce-scatter. Context engine (any --zero "
+                        "level) and pure --fsdp; the bulk reduction "
+                        "stays the oracle")
+    p.add_argument("--bucket-mb", type=float, default=4.0,
+                   help="with --overlap on: target bytes per reduction "
+                        "bucket (MiB)")
     p.add_argument("--attn", default="ring",
                    choices=["ring", "ring-flash", "ulysses",
                             "ulysses-flash", "flash"],
@@ -515,6 +529,14 @@ def train(args) -> float:
                          "subsumes --zero1/--zero2; MoE uses --ep)")
     if args.zero1 and args.zero2:
         raise SystemExit("--zero2 subsumes --zero1; pick one")
+    if args.overlap != "off" and (
+            args.pp > 1 or args.tp > 1 or args.ep > 1 or args.experts
+            or (args.fsdp and (args.sp > 1 or args.tp > 1))):
+        raise SystemExit(
+            "--overlap on supports the context engine (--dp/--sp, any "
+            "--zero level, --accum) and pure --fsdp; the GSPMD tp/ep/"
+            "composite engines schedule compiler-inserted collectives "
+            "and the LM pipeline keeps its own hop schedule")
     # --attn-window composes with every substrate: the XLA/ring/ulysses
     # paths mask (ops/attention.py) and the flash kernel skips
     # out-of-window tiles (ops/flash_attention.py) — no guard needed.
@@ -656,10 +678,13 @@ def train(args) -> float:
                                    fsdp=args.fsdp, health=args.health)
     elif args.fsdp:
         from shallowspeed_tpu.parallel.fsdp import FSDPEngine
+        from shallowspeed_tpu.parallel.overlap import from_flags
 
         mesh = Mesh(devs.reshape(args.dp), ("dp",))
         engine = FSDPEngine(cfg, opt, mesh, seed=args.seed,
-                            health=args.health)
+                            health=args.health,
+                            overlap=from_flags(args.overlap,
+                                               args.bucket_mb))
     elif args.ep > 1 or args.experts:
         from shallowspeed_tpu.parallel.expert import ExpertParallelEngine
 
@@ -679,11 +704,15 @@ def train(args) -> float:
                                       zero1=args.zero1, zero2=args.zero2,
                                       health=args.health)
     else:
+        from shallowspeed_tpu.parallel.overlap import from_flags
+
         mesh = Mesh(devs.reshape(args.dp, args.sp), ("dp", "sp"))
         engine = ContextParallelEngine(cfg, opt, mesh, seed=args.seed,
                                        attn=args.attn, zero1=args.zero1,
                                        zero2=args.zero2, accum=args.accum,
-                                       health=args.health)
+                                       health=args.health,
+                                       overlap=from_flags(
+                                           args.overlap, args.bucket_mb))
 
     start_step = 0
     restored_ckpt = None
