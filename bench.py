@@ -540,6 +540,59 @@ def bench_overlap() -> dict:
         return {"overlap_error": repr(e)[:200]}
 
 
+def bench_attribution() -> dict:
+    """Roofline waterfall of the reference MLP workload's fused step
+    (shallowspeed_tpu/telemetry/attribution.py): from BENCH_r06 on the
+    bench line carries its own `attrib_*` decomposition — measured
+    fenced step time vs analytic compute (matmuls at the MXU peak,
+    fusions at the HBM roofline; calibrated effective rates on
+    non-TPU hosts) — so a throughput drop arrives with its own first
+    diagnosis. Never raises — a failure lands as attribution_error."""
+    import jax
+
+    from shallowspeed_tpu import telemetry as tele
+    from shallowspeed_tpu.engine import FusedDPEngine
+    from shallowspeed_tpu.models.mlp import MLPStage
+    from shallowspeed_tpu.optim import SGD
+    from shallowspeed_tpu.parallel.mesh import make_mesh
+
+    try:
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(N_MU, GBS // N_MU, 784)).astype(np.float32)
+        labels = rng.integers(0, 10, GBS)
+        ys = np.zeros((GBS, 10), np.float32)
+        ys[np.arange(GBS), labels] = 1.0
+        ys = ys.reshape(N_MU, GBS // N_MU, 10)
+
+        class _DS:
+            def load_mubatch_stack(self, batch_id):
+                return xs, ys
+
+        ds = [_DS()]
+        tracer = tele.configure(level="spans")
+        try:
+            stage = MLPStage(LAYER_SIZES, 0, 1, batch_size=GBS)
+            eng = FusedDPEngine(stage, SGD(LR), make_mesh(1, 1))
+            telem = tele.RunTelemetry(eng, tracer, dtype="f32")
+            eng.train_batch(0, ds)  # compile (excluded)
+            jax.device_get(eng.params[0]["b"])
+            telem.step_fields()  # advance the span mark past compile
+            n = 12
+            t0 = time.perf_counter()
+            for b in range(1, 1 + n):
+                eng.train_batch(b, ds)
+            jax.device_get(eng.params[0]["b"])
+            window = time.perf_counter() - t0
+            fields = telem.step_fields(window_secs=window,
+                                       steps_in_window=n)
+        finally:
+            tele.configure(level="off")
+        return {"attribution": {k: v for k, v in fields.items()
+                                if k.startswith("attrib_")}}
+    except Exception as e:  # pragma: no cover — keep the headline robust
+        return {"attribution_error": repr(e)[:200]}
+
+
 def pinned_baseline() -> float | None:
     """The once-recorded NumPy throughput (BASELINE.json) — the stable
     denominator for vs_baseline (VERDICT r1: a re-measured baseline made
@@ -590,6 +643,7 @@ def main():
     out.update(bench_transformer_mfu())
     out.update(bench_kernel_numerics())
     out.update(bench_overlap())
+    out.update(bench_attribution())
     print(json.dumps(out))
 
 

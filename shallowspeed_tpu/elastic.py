@@ -63,6 +63,18 @@ from dataclasses import dataclass, field
 # heartbeat files remain valid "ok" beats.
 
 
+def _argv_log_file(argv: list[str]) -> str | None:
+    """The child command's --log-file value, if any — the metrics
+    JSONL the supervisor's goodput-ledger stamps land in. Accepts
+    both the two-token form and --log-file=PATH."""
+    for i, arg in enumerate(argv):
+        if arg == "--log-file" and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith("--log-file="):
+            return arg.split("=", 1)[1]
+    return None
+
+
 def write_heartbeat(path, status: str = "ok") -> None:
     """One beat: refresh the mtime and record the health status."""
     with open(path, "w") as f:
@@ -125,12 +137,17 @@ class Supervisor:
                  hang_timeout: float | None = None,
                  heartbeat_file: str | None = None,
                  poll_interval: float = 1.0,
-                 log=print):
+                 log=print, ledger_file: str | None = None):
         self.argv = list(argv)
         self.policy = policy or RestartPolicy()
         self.hang_timeout = hang_timeout
         self.poll_interval = poll_interval
         self.log = log
+        # goodput ledger (round 9): restart downtime is stamped into
+        # the SAME metrics JSONL the child writes, so the goodput
+        # reducer sees the whole history in one file. Default: the
+        # child's own --log-file when it has one.
+        self.ledger_file = ledger_file or _argv_log_file(self.argv)
         self._owned_hb = False  # did WE mkstemp it (then we unlink it)
         if hang_timeout is not None and heartbeat_file is None:
             if "--heartbeat-file" in self.argv:
@@ -225,6 +242,7 @@ class Supervisor:
             attempt += 1
             self.log(f"[elastic] attempt {attempt}: {' '.join(self.argv)}")
             code, secs = self._run_once()
+            t_dead = time.monotonic()
             if code == 0:
                 self.log(f"[elastic] child finished cleanly after "
                          f"{secs:.0f}s")
@@ -238,6 +256,19 @@ class Supervisor:
             self.log(f"[elastic] child failed (exit {code}) after "
                      f"{secs:.0f}s; restarting in {delay:.1f}s")
             time.sleep(delay)
+            if self.ledger_file:
+                # stamp the restart downtime (kill-to-respawn, i.e.
+                # backoff + detection latency) into the child's
+                # metrics JSONL — goodput.run_goodput itemizes it, and
+                # cross-checks it against the wall gap the child
+                # stanzas themselves show
+                from shallowspeed_tpu.telemetry.goodput import (
+                    stamp_ledger_line)
+
+                stamp_ledger_line(
+                    self.ledger_file, "restart_downtime",
+                    seconds=round(time.monotonic() - t_dead, 3),
+                    attempt=attempt, exit_code=code)
 
 
 class GangSupervisor(Supervisor):
@@ -267,7 +298,8 @@ class GangSupervisor(Supervisor):
                  policy: RestartPolicy | None = None,
                  hang_timeout: float | None = None,
                  coordinator: str | None = None,
-                 poll_interval: float = 1.0, log=print):
+                 poll_interval: float = 1.0, log=print,
+                 ledger_file: str | None = None):
         # deliberately NOT calling super().__init__: the heartbeat is
         # per-child here (N files, injected per process)
         self.argv = list(argv)
@@ -278,6 +310,9 @@ class GangSupervisor(Supervisor):
         self.coordinator = coordinator
         self.poll_interval = poll_interval
         self.log = log
+        # gang note: a shared --log-file would interleave N processes'
+        # stanzas; restart stamps still help process 0's file
+        self.ledger_file = ledger_file or _argv_log_file(self.argv)
         self.heartbeat_files = []
         if hang_timeout is not None:
             assert "--heartbeat-file" not in self.argv, (

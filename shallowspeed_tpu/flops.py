@@ -39,6 +39,62 @@ _PEAKS_BF16 = {
 }
 
 
+# HBM bandwidth per JAX DEVICE, bytes/s (published spec sheets; same
+# device-vs-chip convention as _PEAKS_BF16 — v2/v3 entries are the
+# per-core half of the shared chip HBM). The v5e entry matches the
+# 819 GB/s this repo's own decode sweeps measured at the roofline
+# (BASELINE.md "flash-decode kernel evaluation").
+_HBM_BPS = {
+    "TPU v2": 350e9,     # 700 GB/s chip, 2 cores
+    "TPU v3": 450e9,     # 900 GB/s chip, 2 cores
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,    # v5p
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+    "TPU v7": 7370e9,
+}
+
+# Aggregate inter-chip interconnect bandwidth per JAX device, bytes/s,
+# one direction (approximate — published aggregate link rates; the
+# attribution waterfall needs order-of-magnitude wire time, not a
+# topology model, and the per-primitive algorithm factors are
+# deliberately left to the reader like collectives.py's byte counts).
+_ICI_BPS = {
+    "TPU v2": 60e9,
+    "TPU v3": 100e9,
+    "TPU v4": 300e9,     # 2400 Gbps
+    "TPU v5 lite": 200e9,  # 1600 Gbps
+    "TPU v5e": 200e9,
+    "TPU v5": 600e9,     # v5p, 4800 Gbps
+    "TPU v5p": 600e9,
+    "TPU v6 lite": 448e9,
+    "TPU v6e": 448e9,
+    "TPU v7": 1200e9,
+}
+
+
+def _lookup_kind(device, table) -> float | None:
+    """Longest-prefix match of `device`'s kind against a peaks table
+    ("TPU v5 lite" beats "TPU v5"); None when unknown (CPU meshes)."""
+    import jax
+
+    if device is None:
+        devs = jax.devices()
+        if not devs:
+            return None
+        device = devs[0]
+    kind = getattr(device, "device_kind", "")
+    best = None
+    for name, val in table.items():
+        if kind.startswith(name):
+            if best is None or len(name) > best[0]:
+                best = (len(name), val)
+    return None if best is None else best[1]
+
+
 def device_peak_flops(device=None, dtype: str = "bf16") -> float | None:
     """Peak FLOP/s of one JAX device of `device`'s kind (default:
     jax.devices()[0]). "Device" is a whole chip on v4+ and a single
@@ -48,26 +104,25 @@ def device_peak_flops(device=None, dtype: str = "bf16") -> float | None:
     Returns None when the device kind is unknown (CPU test meshes) —
     callers should then skip MFU reporting rather than invent a peak.
     """
-    import jax
-
-    if device is None:
-        devs = jax.devices()
-        if not devs:
-            return None
-        device = devs[0]
-    kind = getattr(device, "device_kind", "")
-    peak = None
-    for name, val in _PEAKS_BF16.items():
-        if kind.startswith(name):
-            # longest prefix match ("TPU v5 lite" beats "TPU v5")
-            if peak is None or len(name) > peak[0]:
-                peak = (len(name), val)
-    if peak is None:
+    p = _lookup_kind(device, _PEAKS_BF16)
+    if p is None:
         return None
-    p = peak[1]
     if dtype in ("f32", "float32", "fp32"):
         return p / 8.0  # multi-pass MXU emulation; measured-practical
     return p
+
+
+def device_mem_bandwidth(device=None) -> float | None:
+    """Peak HBM bytes/s of one JAX device (None off-TPU) — the
+    denominator for memory-roofline utilization (decode sweeps,
+    telemetry/attribution's fusion pricing)."""
+    return _lookup_kind(device, _HBM_BPS)
+
+
+def device_ici_bandwidth(device=None) -> float | None:
+    """Approximate aggregate ICI bytes/s of one JAX device (None
+    off-TPU) — telemetry/attribution's exposed-collective wire rate."""
+    return _lookup_kind(device, _ICI_BPS)
 
 
 def _avg_causal_context(seq_len: int, window: int = 0) -> float:

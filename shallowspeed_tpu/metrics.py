@@ -34,7 +34,12 @@ class MetricsLogger:
     def log(self, **fields) -> None:
         if not self.path:
             return
-        fields.setdefault("t", round(time.time() - self._t0, 3))
+        now = time.time()
+        fields.setdefault("t", round(now - self._t0, 3))
+        # schema v4: absolute wall stamp on every line, so the goodput
+        # reducer can account wall clock ACROSS supervisor restarts
+        # (each process's `t` restarts at its own run_start)
+        fields.setdefault("wall", round(now, 3))
         with self.path.open("a") as f:
             f.write(json.dumps(fields) + "\n")
 
@@ -71,7 +76,7 @@ class StepRates:
     """
 
     def __init__(self, tokens_per_step: float, clock=time.time,
-                 telemetry=None, health=None):
+                 telemetry=None, health=None, ledger=None):
         self.tokens_per_step = float(tokens_per_step)
         self._clock = clock
         self._t0 = clock()
@@ -89,12 +94,27 @@ class StepRates:
         # fields (grad/param norms, update ratio, nonfinite counter,
         # skipped-step counter, anomaly verdicts)
         self.health = health
+        # optional telemetry.goodput.GoodputLedger: every `pause` is
+        # ALSO stamped as a ledger event of its kind, so the
+        # throughput windows and the run-level goodput ledger can
+        # never disagree (window-sum + excluded-ledger-seconds ==
+        # wall clock by construction; pinned in tests/test_goodput.py),
+        # and recompile / guarded-skip DELTAS between log points land
+        # as in-window ledger counts
+        self.ledger = ledger
+        self._led_prev = {"recompiles": 0, "health_skipped_total": 0}
 
-    def pause(self, seconds: float) -> None:
+    def pause(self, seconds: float, kind: str = "pause") -> None:
         """Exclude `seconds` of non-training wall time (val eval, ckpt
         save — including an async save's caller-thread snapshot fetch,
-        which stalls the step loop for minutes on big models)."""
+        which stalls the step loop for minutes on big models). `kind`
+        names the goodput-ledger bucket the excluded time lands in
+        (telemetry/goodput.EXCLUDED_KINDS)."""
         self._pause += float(seconds)
+        # sub-0.1ms pauses (e.g. a telemetry call that hit its cache)
+        # would write one near-empty ledger line per log point
+        if self.ledger is not None and float(seconds) > 1e-4:
+            self.ledger.note(kind, seconds=float(seconds))
 
     def log_point(self, steps_since_last: int) -> dict:
         """Close the current window (`steps_since_last` training steps
@@ -118,5 +138,18 @@ class StepRates:
             # telemetry's own cost (the one-time static jaxpr trace can
             # be seconds on a big step) must not depress the NEXT
             # window's rate — book it as excluded pause time
-            self._pause += self._clock() - now
+            self.pause(self._clock() - now, kind="telemetry")
+        if self.ledger is not None:
+            # in-window losses: recompiles and guarded skipped steps
+            # advance as cumulative counters on the step line — stamp
+            # the DELTAS so the reducer can price them
+            for field, kind in (("recompiles", "recompiles"),
+                                ("health_skipped_total",
+                                 "skipped_steps")):
+                cur = out.get(field)
+                if isinstance(cur, int):
+                    delta = cur - self._led_prev[field]
+                    if delta > 0:
+                        self.ledger.note(kind, count=delta)
+                    self._led_prev[field] = cur
         return out

@@ -272,6 +272,88 @@ def _sample(logits, rng, temperature: float, top_k: int,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+# ------------------------------------------------- decode HBM roofline
+#
+# BASELINE.md established (round 4) that decode is HBM-bandwidth-bound
+# at ~800 GB/s on this chip: every step sweeps the KV cache and the
+# weights once. These helpers surface that as a LIVE number on decode
+# progress lines (tokens/sec x bytes/token vs the chip's HBM roofline,
+# `flops.device_mem_bandwidth`) instead of an offline claim. The byte
+# model is pinned against the traced decode program's own input-buffer
+# bytes (analysis/walker.aval_bytes) in tests/test_generate.py.
+
+
+def decode_read_bytes_per_token(params, cfg: T.TransformerConfig,
+                                batch: int, cache_len: int,
+                                kv_quant: str = "") -> int:
+    """HBM READ bytes one decode step moves: every param leaf (at the
+    dtype decode actually reads after `cast_params`) plus every
+    block's full K/V cache sweep (+ int8 scale rows), plus the token
+    ids. Equals the summed input-buffer bytes of the traced
+    `decode_step` program by construction — the walker pin."""
+    import numpy as np
+
+    from shallowspeed_tpu.analysis.walker import aval_bytes
+
+    # eval_shape: the byte count needs only the casted avals, not a
+    # full on-device copy of the model in compute dtype
+    cast = jax.eval_shape(lambda p: T.cast_params(p, cfg.compute_dtype),
+                          params)
+    p_bytes = int(sum(aval_bytes(l) for l in
+                      jax.tree_util.tree_leaves(cast)))
+    kv_itemsize = (1 if kv_quant == "int8"
+                   else np.dtype(cfg.compute_dtype or cfg.dtype).itemsize)
+    per_block = 2 * batch * cfg.kv_heads * cache_len * cfg.head_dim \
+        * kv_itemsize
+    if kv_quant == "int8":
+        per_block += 2 * batch * cfg.kv_heads * cache_len * 4  # f32 scales
+    tok_bytes = batch * 4  # int32 token ids
+    return p_bytes + cfg.n_layers * per_block + tok_bytes
+
+
+def decode_write_bytes_per_token(cfg: T.TransformerConfig, batch: int,
+                                 kv_quant: str = "") -> int:
+    """HBM WRITE bytes per decode step: the one-token K/V cache update
+    per block (+ scales) and the logits row — O(1/cache_len) of the
+    read sweep, reported for completeness."""
+    import numpy as np
+
+    kv_itemsize = (1 if kv_quant == "int8"
+                   else np.dtype(cfg.compute_dtype or cfg.dtype).itemsize)
+    per_block = 2 * batch * cfg.kv_heads * cfg.head_dim * kv_itemsize
+    if kv_quant == "int8":
+        per_block += 2 * batch * cfg.kv_heads * 4
+    return cfg.n_layers * per_block + batch * cfg.vocab * 4
+
+
+def decode_report(params, cfg: T.TransformerConfig, batch: int,
+                  cache_len: int, n_tokens: int, seconds: float,
+                  kv_quant: str = "") -> dict:
+    """Decode progress-line fields for a timed generation: tokens/sec,
+    the analytic bytes/token, the implied HBM sweep rate, and — when
+    the chip's HBM peak is known — the roofline utilization. Off-TPU
+    `hbm_util` is None (no invented peak), matching flops.mfu's
+    convention."""
+    from shallowspeed_tpu.flops import device_mem_bandwidth
+
+    assert seconds > 0 and n_tokens > 0
+    steps_per_sec = n_tokens / seconds          # decode steps (all rows)
+    bpt = (decode_read_bytes_per_token(params, cfg, batch, cache_len,
+                                       kv_quant)
+           + decode_write_bytes_per_token(cfg, batch, kv_quant))
+    gbps = steps_per_sec * bpt / 1e9
+    peak = device_mem_bandwidth()
+    return {
+        "tokens_per_sec": round(steps_per_sec * batch, 1),
+        "steps_per_sec": round(steps_per_sec, 2),
+        "bytes_per_token": int(bpt),
+        "hbm_gbps": round(gbps, 4),
+        "hbm_peak_gbps": None if peak is None else round(peak / 1e9, 1),
+        "hbm_util": None if peak is None else round(gbps * 1e9 / peak,
+                                                    4),
+    }
+
+
 FLASH_PREFILL_THRESHOLD = 2048
 """Prompt-BUCKET length at which `generate` switches the prefill from
 XLA attention to the flash kernel (long prompts OOM on the (B, H, Tp,

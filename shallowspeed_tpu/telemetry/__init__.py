@@ -58,6 +58,11 @@ _LAZY = {
     "fetch_pack": "health",
     "AnomalyDetector": "anomaly", "GuardPolicy": "anomaly",
     "RobustEWMA": "anomaly", "Verdict": "anomaly",
+    # time attribution + goodput + bench regression gate (round 9)
+    "step_waterfall": "attribution", "roofline_of_jaxpr": "attribution",
+    "device_rates": "attribution",
+    "GoodputLedger": "goodput", "run_goodput": "goodput",
+    "check_trajectory": "regress", "load_trajectory": "regress",
 }
 
 

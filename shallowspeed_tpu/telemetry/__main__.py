@@ -1,12 +1,18 @@
-"""CLI: validate committed trace/metrics JSONL against the schema.
+"""CLI: validate committed JSONL, gate the bench trajectory, or reduce
+a run's goodput ledger.
 
     python -m shallowspeed_tpu.telemetry --validate docs_runs/*.jsonl
     python -m shallowspeed_tpu.telemetry --validate docs_runs/
+    python -m shallowspeed_tpu.telemetry --regress BENCH_*.json
+    python -m shallowspeed_tpu.telemetry --regress .
+    python -m shallowspeed_tpu.telemetry --goodput run/metrics.jsonl
 
-Exits 1 listing path:line problems; 0 when every line conforms. This
-is the pre-commit gate for `docs_runs/*.jsonl` — the schema module is
-pure stdlib, so the check costs only the package import (~1 s), not a
-trace of anything.
+--validate and --regress are the pre-commit gates for committed
+`docs_runs/*.jsonl` snapshots and the `BENCH_r*.json` trajectory —
+both pure-stdlib checks that cost only the package import (~1 s), not
+a trace or a bench run of anything. --goodput prints the run-level
+wall-clock decomposition (goodput + named losses) of one metrics
+JSONL, including runs that span supervisor restarts.
 """
 
 from __future__ import annotations
@@ -18,11 +24,30 @@ from pathlib import Path
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m shallowspeed_tpu.telemetry")
-    p.add_argument("--validate", nargs="+", metavar="PATH", required=True,
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--validate", nargs="+", metavar="PATH",
                    help="JSONL files (or directories scanned for "
                         "*.jsonl) to check against the telemetry/"
                         "metrics schema")
+    g.add_argument("--regress", nargs="+", metavar="PATH",
+                   help="BENCH_r*.json files (or directories scanned "
+                        "for them) — fail when the newest round drops "
+                        "below the prior rounds beyond the noise band")
+    g.add_argument("--goodput", metavar="JSONL",
+                   help="reduce one metrics JSONL to the goodput "
+                        "report (wall-clock decomposition + losses)")
     args = p.parse_args(argv)
+
+    if args.regress:
+        from shallowspeed_tpu.telemetry.regress import main as rmain
+
+        return rmain(args.regress)
+    if args.goodput:
+        from shallowspeed_tpu.telemetry.goodput import (format_report,
+                                                        run_goodput)
+
+        print(format_report(run_goodput(args.goodput)))
+        return 0
 
     from shallowspeed_tpu.telemetry.schema import validate_file
 

@@ -1,0 +1,289 @@
+"""Run-level goodput ledger — where every wall-clock second went.
+
+`metrics.StepRates` answers *how fast were the steps*; this module
+answers *how much of the run was steps at all*. The ledger is a stream
+of `{"event": "ledger", "kind": ..., "seconds"/"count": ...}` lines in
+the SAME metrics JSONL the step lines live in — so it survives process
+death, spans supervisor restarts (`elastic.py` stamps restart downtime
+into the same file), and a single reducer (`run_goodput`) can replay
+the whole history into
+
+    goodput = productive-step-time / wall-clock
+
+with a named loss breakdown: init, checkpoint restore/save, validation
+pauses, data-prefetch stalls, guarded skipped steps, compile (derived
+from the first window's excess over the steady step rate),
+replayed-from-checkpoint steps (derived from step numbers that re-run
+after a restart), and restart downtime (measured from the wall gap
+between one process's last line and the next's run_start); recompile
+counts are itemized alongside.
+
+Two classes of ledger kind:
+
+- **excluded** kinds are pauses `StepRates` removes from its
+  throughput windows (val, ckpt_save, restore, init, telemetry,
+  calibration). Because every `StepRates.pause(seconds, kind=...)`
+  call also stamps the ledger, window-sum + excluded-ledger-seconds ==
+  wall clock BY CONSTRUCTION — the step-rate windows and the ledger
+  can never disagree (pinned by tests/test_goodput.py).
+- **in-window** kinds annotate time that stays inside the windows but
+  is not productive (data_stall seconds; skipped_steps counts, priced
+  at the steady per-step rate by the reducer; recompiles counts,
+  itemized — their wall cost already shows in the step rate).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+# Pause kinds StepRates excludes from its throughput windows. Anything
+# else noted with seconds is treated as an in-window loss.
+EXCLUDED_KINDS = ("init", "restore", "val", "ckpt_save", "telemetry",
+                  "calibration", "pause")
+
+
+class GoodputLedger:
+    """Stamps ledger events into a MetricsLogger (or just accumulates
+    in-process totals when `metrics` is None)."""
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+        self._seconds: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+
+    def note(self, kind: str, seconds: float | None = None,
+             count: int | None = None, **extra) -> None:
+        fields: dict = {}
+        if seconds is not None:
+            self._seconds[kind] = (self._seconds.get(kind, 0.0)
+                                   + float(seconds))
+            fields["seconds"] = round(float(seconds), 6)
+        if count is not None:
+            self._counts[kind] = self._counts.get(kind, 0) + int(count)
+            fields["count"] = int(count)
+        if self.metrics is not None:
+            self.metrics.log(event="ledger", kind=kind, **fields,
+                             **extra)
+
+    def seconds(self) -> dict:
+        return dict(self._seconds)
+
+    def counts(self) -> dict:
+        return dict(self._counts)
+
+    def excluded_seconds(self) -> float:
+        return sum(v for k, v in self._seconds.items()
+                   if k in EXCLUDED_KINDS)
+
+
+def stamp_ledger_line(path, kind: str, **fields) -> None:
+    """Append one ledger line to a metrics JSONL from OUTSIDE the
+    training process (the elastic supervisor's restart stamps). Best
+    effort — a supervisor must never die on a full disk."""
+    import time
+
+    rec = {"event": "ledger", "kind": kind,
+           "wall": round(time.time(), 3), **fields}
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+# ------------------------------------------------------------ reducer
+
+
+def _parse(path) -> list[dict]:
+    out = []
+    for line in Path(path).read_text().splitlines():
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "event" in rec:
+            out.append(rec)
+    return out
+
+
+def _wall(rec, stanza_start_wall) -> float | None:
+    if isinstance(rec.get("wall"), (int, float)):
+        return float(rec["wall"])
+    if stanza_start_wall is not None and isinstance(rec.get("t"),
+                                                   (int, float)):
+        return stanza_start_wall + float(rec["t"])
+    return None
+
+
+def run_goodput(path) -> dict:
+    """Reduce one metrics JSONL (one run, possibly spanning supervisor
+    restarts) to the goodput report. Returns
+
+        {"wall_clock_s", "productive_s", "goodput", "accounted_frac",
+         "losses": {kind: seconds}, "counts": {...}, "per_step_s",
+         "stanzas"}
+
+    `accounted_frac` = (productive + sum(losses)) / wall_clock — the
+    acceptance bar is >= 0.95 on a kill/resume run; anything below
+    that means time went somewhere the ledger has no name for.
+    """
+    recs = _parse(path)
+    # split into stanzas at run_start lines
+    stanzas: list[dict] = []
+    for rec in recs:
+        if rec["event"] == "run_start" or not stanzas:
+            stanzas.append({"start": rec if rec["event"] == "run_start"
+                            else None, "lines": []})
+        stanzas[-1]["lines"].append(rec)
+    losses: dict[str, float] = {}
+    counts: dict[str, int] = {"restarts": max(0, len(stanzas) - 1),
+                              "replayed_steps": 0, "skipped_steps": 0,
+                              "recompiles": 0}
+
+    def add_loss(kind, secs):
+        if secs > 0:
+            losses[kind] = losses.get(kind, 0.0) + secs
+
+    # pass 1: walls, step lines, ledger events, per-step rate samples
+    rate_samples: list[float] = []
+    for st in stanzas:
+        start = st["start"] or {}
+        st["w0"] = _wall(start, None) if st["start"] else None
+        walls = [w for w in (_wall(r, st["w0"]) for r in st["lines"])
+                 if w is not None]
+        st["first_wall"] = walls[0] if walls else None
+        # the crash-gap measurement wants the last line the CHILD
+        # wrote ("t" is the process-relative stamp only the child's
+        # MetricsLogger adds); a supervisor restart stamp appended
+        # after the child died must not shrink the measured downtime
+        child_walls = [w for r, w in
+                       zip(st["lines"],
+                           (_wall(r, st["w0"]) for r in st["lines"]))
+                       if w is not None and "t" in r]
+        st["last_wall"] = (child_walls[-1] if child_walls
+                           else walls[-1] if walls else None)
+        st["steps"] = [(r["step"], _wall(r, st["w0"]))
+                       for r in st["lines"] if r["event"] == "step"]
+        st["ledger"] = [(r.get("kind", "?"), r, _wall(r, st["w0"]))
+                        for r in st["lines"] if r["event"] == "ledger"]
+        st["start_step"] = int(start.get("start_step", 0) or 0)
+        # excluded pause seconds between two walls (for window math)
+        ex = [(w, float(r.get("seconds", 0.0)))
+              for k, r, w in st["ledger"]
+              if k in EXCLUDED_KINDS and w is not None]
+
+        def pauses_between(lo, hi, ex=ex):
+            return sum(s for w, s in ex if lo < w <= hi)
+
+        st["pauses_between"] = pauses_between
+        for (s1, w1), (s2, w2) in zip(st["steps"], st["steps"][1:]):
+            if w1 is None or w2 is None or s2 <= s1:
+                continue
+            rate_samples.append(
+                max(0.0, w2 - w1 - pauses_between(w1, w2)) / (s2 - s1))
+    per_step = (float(sorted(rate_samples)[len(rate_samples) // 2])
+                if rate_samples else None)
+
+    # pass 2: productive time, compile excess, replay, ledger losses
+    productive = 0.0
+    high_water = -1
+    for i, st in enumerate(stanzas):
+        for kind, rec, _w in st["ledger"]:
+            secs = rec.get("seconds")
+            if isinstance(secs, (int, float)):
+                # downtime is re-measured from the wall gap below; the
+                # supervisor's own stamp is kept as a cross-check total
+                if kind != "restart_downtime":
+                    add_loss(kind, float(secs))
+            cnt = rec.get("count")
+            if isinstance(cnt, (int, float)) and kind in counts:
+                counts[kind] += int(cnt)
+            elif isinstance(cnt, (int, float)):
+                counts[kind] = counts.get(kind, 0) + int(cnt)
+        if not st["steps"]:
+            high_water = max(high_water, st["start_step"] - 1)
+            continue
+        s_first, w_first = st["steps"][0]
+        s_last, w_last = st["steps"][-1]
+        r0 = st["start_step"]
+        # steady stepping time between the stanza's step lines
+        stepping = 0.0
+        if w_first is not None and w_last is not None:
+            stepping = max(0.0, w_last - w_first
+                           - st["pauses_between"](w_first, w_last))
+        # the first segment: run_start -> first step line holds init/
+        # restore (itemized above), the steps up to s_first, and the
+        # compile excess
+        steps_first = max(0, s_first - r0 + 1)
+        if per_step is not None and st["first_wall"] is not None \
+                and w_first is not None:
+            seg = max(0.0, w_first - st["first_wall"]
+                      - st["pauses_between"](st["first_wall"], w_first))
+            expected = steps_first * per_step
+            add_loss("compile", max(0.0, seg - expected))
+            stepping += min(seg, expected)
+        # replayed steps: work re-run below the previous high-water
+        if i > 0 and per_step is not None:
+            replayed = max(0, min(high_water, s_last) - r0 + 1)
+            counts["replayed_steps"] += replayed
+            replay_s = min(replayed * per_step, stepping)
+            add_loss("replay", replay_s)
+            stepping -= replay_s
+        high_water = max(high_water, s_last)
+        productive += stepping
+        # crash gap to the next stanza = restart downtime (measured)
+        nxt = stanzas[i + 1] if i + 1 < len(stanzas) else None
+        if nxt is not None and st["last_wall"] is not None \
+                and nxt["first_wall"] is not None:
+            add_loss("restart_downtime",
+                     max(0.0, nxt["first_wall"] - st["last_wall"]))
+    # in-window annotated losses come out of productive time
+    for kind in ("data_stall",):
+        productive -= min(productive, losses.get(kind, 0.0))
+    if per_step is not None and counts.get("skipped_steps"):
+        skip_s = counts["skipped_steps"] * per_step
+        add_loss("skipped_steps", min(skip_s, productive))
+        productive -= min(skip_s, productive)
+
+    first = next((s["first_wall"] for s in stanzas
+                  if s["first_wall"] is not None), None)
+    last = next((s["last_wall"] for s in reversed(stanzas)
+                 if s["last_wall"] is not None), None)
+    wall = (last - first) if first is not None and last is not None \
+        else 0.0
+    accounted = productive + sum(losses.values())
+    return {
+        "wall_clock_s": round(wall, 3),
+        "productive_s": round(productive, 3),
+        "goodput": round(productive / wall, 4) if wall > 0 else None,
+        "accounted_frac": (round(min(1.0, accounted / wall), 4)
+                           if wall > 0 else None),
+        "losses": {k: round(v, 3) for k, v in sorted(losses.items())},
+        "counts": counts,
+        "per_step_s": (round(per_step, 6) if per_step is not None
+                       else None),
+        "stanzas": len(stanzas),
+    }
+
+
+def format_report(rep: dict) -> str:
+    """Human-readable goodput report (the --goodput CLI surface)."""
+    lines = [
+        f"wall clock     {rep['wall_clock_s']:>10.2f} s",
+        f"productive     {rep['productive_s']:>10.2f} s   "
+        f"goodput {rep['goodput'] if rep['goodput'] is not None else '—'}",
+    ]
+    wall = rep["wall_clock_s"] or 1.0
+    for kind, secs in sorted(rep["losses"].items(),
+                             key=lambda kv: -kv[1]):
+        lines.append(f"  - {kind:<18} {secs:>8.2f} s  "
+                     f"({secs / wall:6.1%})")
+    extra = {k: v for k, v in rep["counts"].items() if v}
+    if extra:
+        lines.append(f"counts: {extra}")
+    lines.append(f"accounted {rep['accounted_frac'] if rep['accounted_frac'] is not None else '—'}"
+                 f" of wall clock over {rep['stanzas']} process(es)")
+    return "\n".join(lines)

@@ -1,0 +1,129 @@
+"""Bench-trajectory regression gate.
+
+`BENCH_r*.json` is machine-written every round but was never
+machine-read — a slow drift in throughput or MFU would only be caught
+by a human rereading the trajectory. `python -m
+shallowspeed_tpu.telemetry --regress BENCH_*.json` (wired into
+pre-commit) reads the whole trajectory and FAILS when the newest
+round's headline metrics drop below the prior rounds by more than a
+noise band.
+
+Noise bands: bench.py (round 8) records per-side spread diagnostics —
+`(max-min)/median` over its interleaved measurement rounds — in every
+BENCH line from r06 on. The gate derives each metric's band as
+`max(floor, K_SPREAD * max recorded spread)` so a noisy host widens
+its own tolerance instead of crying wolf; the floors come from this
+host's measured behavior (BASELINE.md documents ±7% wall-clock swings
+under load for CPU-side numbers; the bench done-bar is ±2% on MFU, so
+MFU gets a tight floor). Rounds r01–r05 predate the spread fields and
+are covered by the floors alone.
+
+Comparison: the LAST round's value vs the MEDIAN of all prior rounds
+that carry the metric (median, not max — one lucky round must not
+ratchet the bar above the machine's honest rate). Metrics where
+higher is better throughout.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+K_SPREAD = 3.0  # band = max(floor, K_SPREAD * max recorded spread)
+
+# metric -> (floor band, key into parsed["spread"] when recorded)
+METRICS = {
+    "value": (0.15, "tpu"),            # raw samples/sec: host-load prone
+    "vs_baseline": (0.12, "tpu"),      # ratio, but both sides CPU-noisy
+    "transformer_mfu": (0.05, None),   # fused on-chip: the ±2% done-bar
+    "big_model_mfu": (0.05, None),
+}
+
+
+def load_trajectory(paths) -> list[dict]:
+    """Parsed bench entries sorted by round number `n`. Accepts file
+    paths and directories (scanned for BENCH_*.json)."""
+    files: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files.extend(sorted(p.glob("BENCH_*.json")))
+        else:
+            files.append(p)
+    entries = []
+    for f in files:
+        rec = json.loads(Path(f).read_text())
+        parsed = rec.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        entries.append({"n": int(rec.get("n", 0)), "path": str(f),
+                        "parsed": parsed})
+    entries.sort(key=lambda e: e["n"])
+    return entries
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _band(metric: str, entries) -> float:
+    floor, spread_key = METRICS[metric]
+    if spread_key is None:
+        return floor
+    spreads = []
+    for e in entries:
+        sp = e["parsed"].get("spread")
+        if isinstance(sp, dict) and isinstance(sp.get(spread_key),
+                                               (int, float)):
+            spreads.append(float(sp[spread_key]))
+    return max(floor, K_SPREAD * max(spreads)) if spreads else floor
+
+
+def check_trajectory(entries) -> tuple[list[str], list[str]]:
+    """(problems, report_lines) for one trajectory. Empty problems =
+    the gate passes. Needs >= 2 entries carrying a metric to judge it;
+    a trajectory of 0/1 entries passes vacuously."""
+    problems: list[str] = []
+    report: list[str] = []
+    if len(entries) < 2:
+        return problems, [f"{len(entries)} bench round(s) — nothing to "
+                          f"compare"]
+    last = entries[-1]
+    prior = entries[:-1]
+    for metric in METRICS:
+        cur = last["parsed"].get(metric)
+        hist = [e["parsed"][metric] for e in prior
+                if isinstance(e["parsed"].get(metric), (int, float))]
+        if not isinstance(cur, (int, float)) or not hist:
+            continue
+        ref = _median(hist)
+        band = _band(metric, entries)
+        drop = (ref - cur) / ref if ref > 0 else 0.0
+        verdict = "OK" if drop <= band else "REGRESSION"
+        report.append(
+            f"{metric:<18} r{last['n']:02d}={cur:<12.4g} "
+            f"median(prior {len(hist)})={ref:<12.4g} "
+            f"drop={drop:+7.2%}  band={band:.0%}  {verdict}")
+        if drop > band:
+            problems.append(
+                f"{metric}: r{last['n']:02d} value {cur:.6g} is "
+                f"{drop:.1%} below the prior-round median {ref:.6g} "
+                f"(noise band {band:.0%}) — {last['path']}")
+    if not report:
+        report.append("no shared metrics across rounds")
+    return problems, report
+
+
+def main(paths) -> int:
+    entries = load_trajectory(paths)
+    problems, report = check_trajectory(entries)
+    print(f"bench trajectory: {len(entries)} round(s) "
+          f"({', '.join('r%02d' % e['n'] for e in entries)})")
+    for line in report:
+        print("  " + line)
+    for p in problems:
+        print("REGRESSION: " + p)
+    print("regress gate: " + ("FAIL" if problems else "OK"))
+    return 1 if problems else 0

@@ -27,12 +27,16 @@ from pathlib import Path
 # the comm/compute-overlap fields (`exposed_comm_frac` /
 # `overlap_ratio` — the step program's dataflow communication
 # exposure, `parallel/overlap.collective_exposure` — and the engine's
-# `overlap` mode flag). Writers stamp it on their run_start line
-# (metrics.MetricsLogger); the validator accepts ALL dialects — every
-# versioned field is optional, so committed v1/v2 artifacts (no
-# version stamp / no health / no overlap fields) keep validating
-# unchanged.
-SCHEMA_VERSION = 3
+# `overlap` mode flag); 4 = v3 plus the time-attribution waterfall
+# (`attrib_*` step fields, `telemetry/attribution.py`), the goodput
+# ledger (`"ledger"` events, `telemetry/goodput.py`) and the absolute
+# `wall` timestamp every metrics line now carries so the ledger
+# reducer can account wall clock ACROSS process restarts. Writers
+# stamp it on their run_start line (metrics.MetricsLogger); the
+# validator accepts ALL dialects — every versioned field is optional,
+# so committed v1/v2/v3 artifacts (no version stamp / no health /
+# overlap / attrib / wall fields) keep validating unchanged.
+SCHEMA_VERSION = 4
 
 _NUM = (int, float)
 
@@ -47,7 +51,17 @@ _METRIC_EVENTS = {
     "bubble": {"bubble_static": _NUM},
     "telemetry": {},
     "health": {"step": int},   # HealthMonitor verdict/summary lines
+    # schema v4: goodput-ledger lines (telemetry/goodput.py) — stamped
+    # by metrics.StepRates pauses, the drivers, and the elastic
+    # supervisor (restart downtime), all into the same JSONL
+    "ledger": {"kind": str},
+    # schema v4: decode throughput + HBM-roofline line (models/
+    # generate.decode_report via the LM driver)
+    "generate": {"tokens_per_sec": _NUM},
 }
+
+# optional typed fields on a "ledger" line
+_LEDGER_OPTIONAL = {"seconds": _NUM, "count": int}
 
 # telemetry fields a step line MAY carry; when present they must type
 _STEP_TELEMETRY = {
@@ -64,6 +78,13 @@ _STEP_TELEMETRY = {
     "health_groups": dict,
     # --- schema v3: comm/compute-overlap fields (parallel/overlap.py)
     "exposed_comm_frac": _NUM, "overlap_ratio": _NUM, "overlap": bool,
+    # --- schema v4: time-attribution waterfall (telemetry/
+    # attribution.py) — fractions of the measured (fenced) step time
+    "attrib_compute_frac": _NUM, "attrib_mxu_frac": _NUM,
+    "attrib_comm_exposed_frac": _NUM, "attrib_bubble_frac": _NUM,
+    "attrib_host_frac": _NUM, "attrib_unexplained_frac": _NUM,
+    "attrib_t_step_ms": _NUM, "attrib_rates_source": str,
+    "attrib_compute_scale": _NUM,
 }
 
 _SPAN_PH = {"X", "i", "C"}
@@ -103,6 +124,15 @@ def _validate_metric(rec: dict) -> list[str]:
                     and not isinstance(rec[field], typ):
                 probs.append(f"step: telemetry field {field!r} is "
                              f"{type(rec[field]).__name__}")
+    if ev == "ledger":
+        for field, typ in _LEDGER_OPTIONAL.items():
+            if field in rec and (not isinstance(rec[field], typ)
+                                 or isinstance(rec[field], bool)):
+                probs.append(f"ledger: field {field!r} is "
+                             f"{type(rec[field]).__name__}")
+    # schema v4: any metrics line may carry an absolute `wall` stamp
+    if "wall" in rec and not isinstance(rec["wall"], _NUM):
+        probs.append("metrics: 'wall' is not numeric")
     return probs
 
 

@@ -489,3 +489,49 @@ def test_flash_prefill_matches_xla_prefill():
         np.testing.assert_allclose(np.asarray(bf["v"]),
                                    np.asarray(bx["v"]), rtol=1e-5,
                                    atol=1e-5)
+
+
+# ------------------------------------- decode HBM roofline (round 9)
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"], ids=["bf16", "int8"])
+def test_decode_bytes_per_token_matches_walker_count(kv_quant):
+    """The analytic bytes-per-token model behind the decode progress
+    line equals the traced decode program's own input-buffer bytes
+    (analysis/walker.aval_bytes over the jaxpr invars) — the model
+    cannot drift from what the program actually sweeps."""
+    from shallowspeed_tpu.analysis.walker import aval_bytes
+    from shallowspeed_tpu.models.generate import (
+        decode_read_bytes_per_token, decode_write_bytes_per_token)
+
+    cfg = CFG
+    b, cache_len = 2, 24
+    params = T.cast_params(T.init(cfg, seed=0), cfg.compute_dtype)
+    cache = init_kv_cache(cfg, b, cache_len, kv_quant)
+    tok = np.zeros((b,), np.int32)
+
+    closed = jax.make_jaxpr(
+        lambda p, t, c: decode_step(p, t, 5, c, cfg))(params, tok, cache)
+    invar_bytes = sum(aval_bytes(v.aval) for v in closed.jaxpr.invars)
+    model = decode_read_bytes_per_token(params, cfg, b, cache_len,
+                                        kv_quant)
+    assert model == invar_bytes, (model, invar_bytes)
+    # writes are the one-token cache update + the logits row —
+    # O(1/cache_len) of the read sweep
+    w = decode_write_bytes_per_token(cfg, b, kv_quant)
+    assert 0 < w < model
+
+
+def test_decode_report_fields_and_cpu_roofline_none():
+    from shallowspeed_tpu.models.generate import decode_report
+
+    params = T.init(CFG, seed=0)
+    rep = decode_report(params, CFG, batch=2, cache_len=24,
+                        n_tokens=8, seconds=0.5)
+    assert rep["tokens_per_sec"] == pytest.approx(2 * 8 / 0.5)
+    assert rep["steps_per_sec"] == pytest.approx(16.0)
+    assert rep["bytes_per_token"] > 0
+    assert rep["hbm_gbps"] == pytest.approx(
+        16.0 * rep["bytes_per_token"] / 1e9, abs=1e-4)
+    # CPU test mesh: no published HBM peak -> no invented utilization
+    assert rep["hbm_peak_gbps"] is None and rep["hbm_util"] is None

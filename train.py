@@ -252,6 +252,7 @@ def train(args) -> float:
         "pipedream": PipeDreamSchedule,
     }[args.schedule]
 
+    t_proc0 = time.time()  # goodput ledger: init = entry -> epoch loop
     engine, train_ds, val_ds = build(args)
     n_batches = train_ds[0].get_num_batches()
     if args.max_batches:
@@ -278,6 +279,12 @@ def train(args) -> float:
         args.log_file, dp=args.dp, pp=args.pp, schedule=args.schedule,
         engine=type(engine).__name__, batch_size=args.batch_size)
 
+    # goodput ledger (telemetry/goodput): init / val-eval / save time
+    # stamped into the same JSONL so `--goodput` decomposes the run
+    from shallowspeed_tpu.telemetry.goodput import GoodputLedger
+
+    ledger = GoodputLedger(metrics)
+
     # ---- runtime telemetry (shallowspeed_tpu/telemetry)
     from shallowspeed_tpu import telemetry as tele
 
@@ -285,8 +292,10 @@ def train(args) -> float:
         args.telemetry = "steps"  # --trace-dir implies tracing
     tracer = tele.configure(trace_dir=args.trace_dir or None,
                             level=args.telemetry)
-    telem = (tele.RunTelemetry(engine, tracer)
+    telem = (tele.RunTelemetry(engine, tracer, dtype="f32")
              if args.telemetry != "off" else None)
+    if telem is not None:
+        telem.ledger = ledger
     if telem is not None and args.pp > 1:
         telem.set_bubble(bubble_static=tele.static_bubble(
             args.schedule, args.mubatches,
@@ -311,11 +320,14 @@ def train(args) -> float:
 
     profile_ctx = (jax.profiler.trace(args.profile_dir)
                    if args.profile_dir else contextlib.nullcontext())
+    ledger.note("init", seconds=time.time() - t_proc0)
     start = time.time()
     accuracy = 0.0
     with profile_ctx:
         for epoch in range(start_epoch, args.epochs):
+            t_val = time.time()
             accuracy = compute_accuracy(engine, val_ds)
+            ledger.note("val", seconds=time.time() - t_val)
             rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
                    f"Accuracy: {accuracy * 100:.2f}%")
             if args.heartbeat_file:
@@ -381,7 +393,9 @@ def train(args) -> float:
                            f"{tf.get('bubble_static', 0.0):.1%}  "
                            f"hbm {tf.get('hbm_live_mib', 0):,.0f} MiB")
             if args.save_dir:
+                t_save = time.time()
                 checkpoint.save(args.save_dir, engine, epoch)
+                ledger.note("ckpt_save", seconds=time.time() - t_save)
 
     accuracy = compute_accuracy(engine, val_ds)
     rprint(f"Epoch: {args.epochs}, Time Spent: {time.time() - start:.2f}s, "

@@ -431,6 +431,7 @@ def make_batch(args, vocab, step: int, text_data=None):
 
 
 def train(args) -> float:
+    t_proc0 = time.time()  # goodput ledger: init = entry -> step loop
     import jax
 
     # multi-host: connect to the JAX distributed service when a
@@ -720,11 +721,14 @@ def train(args) -> float:
         # elastic restarts: resume iff a checkpoint exists, else fresh
         if checkpoint.latest(args.save_dir) is not None:
             args.resume = True
+    restore_secs = 0.0
     if args.resume or args.sample_only:  # save-dir presence checked early
         ck = checkpoint.latest(args.save_dir)
         if ck is None:
             raise SystemExit(f"--resume: no checkpoint under {args.save_dir!r}")
+        t_restore = time.time()
         start_step = checkpoint.restore(engine, ck)
+        restore_secs = time.time() - t_restore
         restored_ckpt = ck
         rprint(f"resumed from {ck} at step {start_step}")
 
@@ -733,9 +737,24 @@ def train(args) -> float:
             f"checkpoint is already at step {start_step} >= --steps "
             f"{args.steps}; nothing to do")
 
+    # run_start carries start_step so the goodput reducer can tell
+    # replayed-from-checkpoint steps from fresh work after a restart
     metrics = MetricsLogger(args.log_file, dp=args.dp, sp=args.sp,
                             seq_len=args.seq_len, d_model=args.d_model,
-                            n_layers=args.n_layers)
+                            n_layers=args.n_layers,
+                            start_step=start_step)
+
+    # ---- goodput ledger (telemetry/goodput): every non-step second is
+    # stamped into the same JSONL the step lines live in — init,
+    # restore, val/save pauses, data stalls, recompile/skip counts —
+    # so `python -m shallowspeed_tpu.telemetry --goodput <log-file>`
+    # can decompose the run's wall clock even across supervisor
+    # restarts (elastic.py stamps the downtime between processes)
+    from shallowspeed_tpu.telemetry.goodput import GoodputLedger
+
+    ledger = GoodputLedger(metrics)
+    if restore_secs:
+        ledger.note("restore", seconds=restore_secs)
 
     # ---- runtime telemetry (shallowspeed_tpu/telemetry): span tracing,
     # HBM/collective/recompile step-line fields, bubble accounting
@@ -745,8 +764,11 @@ def train(args) -> float:
         args.telemetry = "steps"  # --trace-dir implies tracing
     tracer = tele.configure(trace_dir=args.trace_dir or None,
                             level=args.telemetry)
-    telem = (tele.RunTelemetry(engine, tracer)
+    telem = (tele.RunTelemetry(engine, tracer,
+                               dtype="bf16" if args.bf16 else "f32")
              if args.telemetry != "off" else None)
+    if telem is not None:
+        telem.ledger = ledger  # loss totals ride telemetry.json too
     # ---- training health (telemetry/health.py): the engines compute
     # the pack on device every step; the monitor fetches it at log
     # points, runs the anomaly detectors, and its fields ride the same
@@ -857,7 +879,7 @@ def train(args) -> float:
     if args.sample_only:
         with ema_weights():
             sample_and_print(args, engine, cfg, vocab, text_data,
-                             tokenizer)
+                             tokenizer, metrics=metrics)
         return float("nan")
 
     from shallowspeed_tpu.metrics import StepRates
@@ -868,7 +890,12 @@ def train(args) -> float:
     # time — round-4 endurance lesson). With telemetry on, every
     # log_point line additionally carries the telemetry fields.
     rates = StepRates(args.batch_size * args.seq_len, telemetry=telem,
-                      health=monitor)
+                      health=monitor, ledger=ledger)
+    # everything before the step loop (imports, engine build, data
+    # prep; restore is itemized separately) is init time
+    ledger.note("init", seconds=max(0.0, time.time() - t_proc0
+                                    - restore_secs))
+    data_stall = 0.0  # next(placed) wait since the last log point
     last_logged = start_step - 1
     loss = float("nan")
     from shallowspeed_tpu.data.prefetch import prefetch_to_device, sync_every
@@ -890,10 +917,20 @@ def train(args) -> float:
         depth=args.prefetch)
     profile_ctx = (jax.profiler.trace(args.profile_dir)
                    if args.profile_dir else contextlib.nullcontext())
+    t_loop_done = None  # set at loop exit; teardown time is ledgered
     try:
         with profile_ctx:
-            for step, (tok, tgt) in zip(range(start_step, args.steps),
-                                        placed):
+            placed_it = iter(placed)
+            for step in range(start_step, args.steps):
+                # input-pipeline stall accounting: with prefetch ahead
+                # this wait is ~0; a slow producer shows up as
+                # data_stall seconds in the goodput ledger
+                t_fetch = time.time()
+                try:
+                    tok, tgt = next(placed_it)
+                except StopIteration:
+                    break
+                data_stall += time.time() - t_fetch
                 loss_dev = engine.train_batch_async(tok, tgt)
                 if ema is not None:
                     ema = ema_update(ema, engine.params, args.ema_decay)
@@ -950,6 +987,9 @@ def train(args) -> float:
                             f"--lr-schedule with --warmup-steps")
                     r = rates.log_point(step - last_logged)
                     last_logged = step
+                    if data_stall > 0.01:
+                        ledger.note("data_stall", seconds=data_stall)
+                    data_stall = 0.0
                     # achieved TFLOP/s + fraction-of-peak (exact matmul
                     # count per token; None off-TPU where no peak is
                     # known). Rates are GLOBAL — divide by the engine's
@@ -1011,6 +1051,24 @@ def train(args) -> float:
                                 f"RECOMPILES {tfields['recompiles']}")
                         if parts:
                             rprint("             " + "  ".join(parts))
+                        if "attrib_unexplained_frac" in tfields:
+                            wf = [f"compute "
+                                  f"{tfields['attrib_compute_frac']:.0%}"]
+                            if "attrib_comm_exposed_frac" in tfields:
+                                wf.append(
+                                    f"comm {tfields['attrib_comm_exposed_frac']:.0%}")
+                            if "attrib_bubble_frac" in tfields:
+                                wf.append(
+                                    f"bubble {tfields['attrib_bubble_frac']:.0%}")
+                            if "attrib_host_frac" in tfields:
+                                wf.append(
+                                    f"host {tfields['attrib_host_frac']:.0%}")
+                            rprint(
+                                "             waterfall "
+                                + " + ".join(wf) + " -> unexplained "
+                                + f"{tfields['attrib_unexplained_frac']:.0%}"
+                                + f"  (t_step "
+                                  f"{tfields['attrib_t_step_ms']:.0f} ms)")
                     if (telem is not None
                             and args.telemetry == "spans"
                             and args.pp > 1
@@ -1028,7 +1086,7 @@ def train(args) -> float:
                         cal = _bubble.calibrate_compiled(
                             engine, tracer, local_rows(htok),
                             local_rows(htgt))
-                        rates.pause(time.time() - tc)
+                        rates.pause(time.time() - tc, kind="calibration")
                         if cal is not None:
                             telem.set_bubble(
                                 bubble_static=cal["bubble_static"],
@@ -1061,7 +1119,7 @@ def train(args) -> float:
                     jax.block_until_ready(loss_dev)
                     tv = time.time()
                     vl = val_loss(step)
-                    rates.pause(time.time() - tv)
+                    rates.pause(time.time() - tv, kind="val")
                     rprint(f"step {step:5d}  val_loss {vl:.4f}  "
                            f"ppl {np.exp(min(vl, 20)):,.2f}")
                     metrics.log(event="val", step=step,
@@ -1075,7 +1133,8 @@ def train(args) -> float:
                     # window's rate — round-4 endurance lesson
                     ts = time.time()
                     save_ckpt(args.save_dir, step)
-                    rates.pause(time.time() - ts)
+                    rates.pause(time.time() - ts, kind="ckpt_save")
+            t_loop_done = time.time()
     finally:
         # abandoning mid-stream must not leave placed batches pinned on
         # device by a blocked producer thread
@@ -1086,6 +1145,11 @@ def train(args) -> float:
             if args.trace_dir:
                 path = telem.write_summary(args.trace_dir)
                 rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
+        if t_loop_done is not None:
+            # loop exit -> here: profiler trace write, prefetch close,
+            # tracer flush + summary — wall clock the ledger must name
+            ledger.note("teardown",
+                        seconds=max(0.0, time.time() - t_loop_done))
         if saver is not None:
             if sys.exc_info()[0] is None:
                 # wait() is the COLLECTIVE failure-exchange point: if
@@ -1105,13 +1169,18 @@ def train(args) -> float:
                           f"teardown: {ckpt_err!r}", file=sys.stderr)
 
     if args.generate > 0:
+        t_sample = time.time()
         with ema_weights():
             sample_and_print(args, engine, cfg, vocab, text_data,
-                             tokenizer)
+                             tokenizer, metrics=metrics)
+        # post-training sampling is wall-clock the goodput ledger must
+        # name (decode compile alone can be seconds)
+        ledger.note("sample", seconds=time.time() - t_sample)
     return loss
 
 
-def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
+def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None,
+                     metrics=None):
     """KV-cache decode from the trained/restored model: --prompt (bytes,
     or BPE ids with --tokenizer bpe) or a 16-token data-stream prefix."""
     from shallowspeed_tpu.models.generate import generate
@@ -1140,10 +1209,15 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
         # onto one device's memory); token-stream-identical to the
         # replicated path. --kv-int8 routes to the replicated path
         # (the quantized cache lives in models/generate only)
+        t0 = time.time()
         out = engine.generate(prompt, args.generate,
                               temperature=args.temperature,
                               top_k=args.top_k, top_p=args.top_p,
                               seed=args.seed)
+        out = np.asarray(out)  # drain the dispatch before timing stops
+        dt = time.time() - t0
+        rprint(f"decode: {prompt.shape[0] * args.generate / dt:,.0f} "
+               f"tok/s (pp-sharded decode; includes prefill+compile)")
     else:
         if args.kv_int8 and hasattr(engine, "generate"):
             # the quantized cache lives in the replicated decode path
@@ -1154,11 +1228,34 @@ def sample_and_print(args, engine, cfg, vocab, text_data, tokenizer=None):
                    "(full params re-gathered to one device); the "
                    "pipelined per-stage cache stays bf16 — drop "
                    "--kv-int8 to decode on the pp-sharded params")
+        from shallowspeed_tpu.models.generate import (decode_report,
+                                                      prompt_bucket_len)
+
+        params = engine.get_canonical_params()
+        kvq = "int8" if args.kv_int8 else ""
+        # time the STEADY-STATE decode: the first call compiles and
+        # prefills, so rate it over a second call's scan only when the
+        # generation is long enough to care; otherwise report the
+        # single-shot rate with compile included, and say so
+        t0 = time.time()
         out = np.asarray(generate(
-            engine.get_canonical_params(), prompt, cfg, args.generate,
+            params, prompt, cfg, args.generate,
             temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, seed=args.seed,
-            kv_quant="int8" if args.kv_int8 else ""))
+            top_p=args.top_p, seed=args.seed, kv_quant=kvq))
+        dt = time.time() - t0
+        cache_len = prompt_bucket_len(prompt.shape[1], args.generate,
+                                      cfg.max_seq) + args.generate
+        rep = decode_report(params, cfg, prompt.shape[0], cache_len,
+                            args.generate, dt, kv_quant=kvq)
+        util = ("" if rep["hbm_util"] is None else
+                f"  ({rep['hbm_util']:.0%} of the "
+                f"{rep['hbm_peak_gbps']:,.0f} GB/s HBM roofline)")
+        rprint(f"decode: {rep['tokens_per_sec']:,.0f} tok/s  "
+               f"~{rep['bytes_per_token'] / 2**20:.1f} MiB/token sweep "
+               f"-> {rep['hbm_gbps']:.1f} GB/s{util} "
+               f"[includes prefill+compile]")
+        if metrics is not None:
+            metrics.log(event="generate", **rep)
     if tokenizer is not None:
         rprint(f"prompt: {tokenizer.decode_bytes(prompt[0])!r}")
         rprint(f"sample: {tokenizer.decode_bytes(out[0])!r}")
