@@ -60,6 +60,7 @@ _EXPORTS = {
     "RestartPolicy": "shallowspeed_tpu.elastic",
     # subsystem modules
     "analysis": "shallowspeed_tpu.analysis",
+    "chaos": "shallowspeed_tpu.chaos",
     "checkpoint": "shallowspeed_tpu.checkpoint",
     "distributed": "shallowspeed_tpu.distributed",
     "elastic": "shallowspeed_tpu.elastic",
@@ -69,8 +70,8 @@ _EXPORTS = {
     "utils": "shallowspeed_tpu.utils",
 }
 
-_MODULE_EXPORTS = {"analysis", "checkpoint", "distributed", "elastic",
-                   "metrics", "optim", "telemetry", "utils"}
+_MODULE_EXPORTS = {"analysis", "chaos", "checkpoint", "distributed",
+                   "elastic", "metrics", "optim", "telemetry", "utils"}
 
 __all__ = sorted(_EXPORTS) + ["functional"]
 

@@ -28,17 +28,31 @@ repo is the PyTorch baseline's `torch.save`,
   structure descriptor. No pickle anywhere (a checkpoint from an untrusted
   source cannot execute code at load time), no orbax dependency, loadable
   with plain numpy.
-- **Atomic**: `save` writes `ckpt_N.tmp/` and renames it into place, so a
-  crash mid-save never leaves a directory that `latest()` would pick up;
-  `latest()` additionally ignores incomplete/foreign entries.
+- **Atomic AND durable**: `save` writes `ckpt_N.tmp/` and renames it into
+  place, so a crash mid-save never leaves a directory that `latest()`
+  would pick up; every npz (and the directories around the rename) is
+  fsync'd, so a *host* crash after the rename cannot lose a checkpoint
+  the caller was told is durable; `latest()` additionally ignores
+  incomplete/foreign entries.
+- **Integrity** (round 10): the atomic dir carries a per-file SHA-256
+  `manifest.json`; `restore`/`latest()` verify it, raise a typed
+  `CheckpointError` (never a raw `zipfile.BadZipFile`) on any load-path
+  failure, quarantine a corrupt dir as `ckpt_N.corrupt`, and fall back
+  to the newest *verified* checkpoint (`restore_latest`). Retention
+  (`keep=`/`--keep-last`) never deletes the last verified checkpoint.
+  Pre-manifest checkpoints stay restorable (verified by completeness
+  only — there is nothing to hash against).
 - `restore` validates the checkpoint's parameter structure and shapes
   against the engine before installing anything — a config-mismatched
-  `--resume` is a hard error, not silent corruption.
+  `--resume` is a hard error (`ValueError`, a user error distinct from
+  corruption), not silent corruption.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import re
 import shutil
 import warnings
@@ -50,6 +64,128 @@ import numpy as np
 tree_flatten = jax.tree_util.tree_flatten
 
 _FILES = ("params.npz", "opt.npz")
+_MANIFEST = "manifest.json"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be trusted or loaded: integrity
+    verification failed, an npz is unreadable/truncated, or a manifest
+    member is missing. Carries the offending path — callers quarantine
+    it and fall back to the newest verified checkpoint."""
+
+    def __init__(self, msg: str, path=None):
+        super().__init__(msg)
+        self.path = Path(path) if path is not None else None
+
+
+# ------------------------------------------------------------ durability
+
+
+def _fsync_path(path) -> None:
+    """fsync a file or directory by fd — the rename-based atomicity
+    story is only durable if the data AND the directory entries are
+    forced out before we report success."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+# -------------------------------------------------------------- integrity
+
+
+def _sha256(path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(ckpt_dir) -> Path:
+    """Per-file SHA-256 manifest over every npz in the directory —
+    written INSIDE the atomic tmp dir, so a renamed checkpoint always
+    carries its own integrity record."""
+    d = Path(ckpt_dir)
+    files = {p.name: {"sha256": _sha256(p), "bytes": p.stat().st_size}
+             for p in sorted(d.glob("*.npz"))}
+    path = d / _MANIFEST
+    path.write_text(json.dumps({"version": 1, "files": files},
+                               indent=0) + "\n")
+    _fsync_path(path)
+    return path
+
+
+def verify(ckpt_dir) -> None:
+    """Raise CheckpointError unless the checkpoint's bytes match its
+    manifest. Pre-manifest checkpoints (nothing to hash against) pass
+    on completeness alone — new saves always write a manifest."""
+    d = Path(ckpt_dir)
+    man = d / _MANIFEST
+    if not man.exists():
+        for f in _FILES:
+            if not (d / f).exists():
+                raise CheckpointError(
+                    f"checkpoint {d} is incomplete (no {f}, no "
+                    f"manifest)", path=d / f)
+        return  # legacy: complete, no manifest to check against
+    try:
+        listed = json.loads(man.read_text())["files"]
+        # valid JSON of the wrong SHAPE (bit rot can keep JSON valid)
+        # must quarantine like any other corruption, not escape as a
+        # raw TypeError that crashes every supervisor restart
+        if not isinstance(listed, dict) or not all(
+                isinstance(rec, dict) for rec in listed.values()):
+            raise TypeError("manifest 'files' is not a dict of dicts")
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise CheckpointError(
+            f"checkpoint {d} has an unreadable manifest ({e})",
+            path=man) from e
+    for name, rec in sorted(listed.items()):
+        p = d / name
+        if not p.exists():
+            raise CheckpointError(
+                f"checkpoint {d}: manifest lists {name} but the file "
+                f"is missing", path=p)
+        size = p.stat().st_size
+        if size != rec.get("bytes"):
+            raise CheckpointError(
+                f"checkpoint {d}: {name} is {size} bytes, manifest "
+                f"says {rec.get('bytes')} (truncated?)", path=p)
+        digest = _sha256(p)
+        if digest != rec.get("sha256"):
+            raise CheckpointError(
+                f"checkpoint {d}: {name} SHA-256 mismatch "
+                f"({digest[:12]}… != {str(rec.get('sha256'))[:12]}…)",
+                path=p)
+
+
+def is_verified(ckpt_dir) -> bool:
+    try:
+        verify(ckpt_dir)
+        return True
+    except CheckpointError:
+        return False
+
+
+def quarantine(ckpt_dir) -> Path | None:
+    """Rename a bad checkpoint dir to `ckpt_N.corrupt` (numbered on
+    collision) so `latest()` never considers it again but the bytes
+    stay available for forensics. Returns the new path, or None when
+    the rename lost a race (another process already moved it)."""
+    d = Path(ckpt_dir)
+    target = d.with_name(d.name + ".corrupt")
+    n = 1
+    while target.exists():
+        n += 1
+        target = d.with_name(f"{d.name}.corrupt{n}")
+    try:
+        d.rename(target)
+    except OSError:
+        return None
+    warnings.warn(f"quarantined corrupt checkpoint {d} -> {target}")
+    return target
 
 
 # ----------------------------------------------------------- pytree <-> npz
@@ -132,26 +268,47 @@ def _write_ckpt(ckpt_dir, epoch: int, params, opt_state, meta: dict,
     extra = {k: fetch_global(v) for k, v in sorted(extra.items())}
     if opt_canon is not None:
         opt_canon = fetch_global(opt_canon)
+    from shallowspeed_tpu import chaos
+
     final = Path(ckpt_dir) / f"ckpt_{epoch}"
     if not process_zero():
         if sync:
             barrier(f"ckpt_{epoch}")
         return final
+    chaos.on_save("start")  # fault injection: ENOSPC / kill-in-save
     tmp = Path(ckpt_dir) / f"ckpt_{epoch}.tmp"
     if tmp.exists():
         shutil.rmtree(tmp)
     tmp.mkdir(parents=True)
-    save_pytree(tmp / "params.npz", params)
-    save_pytree(tmp / "opt.npz", opt_state, meta=meta)
+
+    def _write(name, tree, meta=None):
+        save_pytree(tmp / name, tree, meta=meta)
+        # durability: force the bytes out BEFORE the rename publishes
+        # the dir — the atomic-rename story is otherwise only atomic
+        # against process crashes, not host crashes
+        _fsync_path(tmp / name)
+        chaos.on_save(f"file:{name}")
+
+    _write("params.npz", params)
+    _write("opt.npz", opt_state, meta=meta)
     if opt_canon is not None:
-        save_pytree(tmp / "opt_canon.npz", opt_canon, meta=canon_meta)
+        _write("opt_canon.npz", opt_canon, meta=canon_meta)
     for name, tree in extra.items():
-        save_pytree(tmp / f"{name}.npz", tree)
+        _write(f"{name}.npz", tree)
+    write_manifest(tmp)
+    _fsync_path(tmp)
+    chaos.on_save("pre_rename")
     if final.exists():
         shutil.rmtree(final)
     tmp.rename(final)
+    _fsync_path(final.parent)  # the rename itself must be durable too
+    chaos.on_save("renamed")
     if keep:
-        prune(ckpt_dir, keep)
+        prune(ckpt_dir, keep, trusted=final)
+    chaos.after_save(final)    # post-hoc corruption faults (after
+    #                            rotation: bit rot strikes a COMPLETED
+    #                            save, and prune's trusted fast path
+    #                            must not vouch for corrupted bytes)
     if sync:
         # releases the other processes only once the rename landed
         barrier(f"ckpt_{epoch}")
@@ -222,21 +379,44 @@ def _canon_opt_import(engine, canon):
         return None
 
 
-def prune(ckpt_dir, keep: int) -> None:
-    """Delete all COMPLETE `ckpt_N` directories except the `keep`
-    highest-epoch ones (rotation — a long elastic run otherwise
-    accumulates multi-GB checkpoints without bound). `.tmp` leftovers
-    and foreign names are untouched; the newest checkpoints survive, so
-    `latest()` is unaffected. Process-0-only by construction (called
-    from the write path)."""
-    assert keep >= 1, f"prune keeps at least one checkpoint, got {keep}"
+def _candidates(ckpt_dir) -> list[tuple[int, Path]]:
+    """(epoch, path) for every directory that *claims* to be a complete
+    checkpoint: a manifest marks completion for new saves; the legacy
+    rule (both npz present) covers pre-manifest dirs. `.tmp` leftovers,
+    `.corrupt` quarantines, and foreign names never qualify."""
     d = Path(ckpt_dir)
     found = []
     for p in d.iterdir() if d.exists() else ():
         m = re.fullmatch(r"ckpt_(\d+)", p.name)
-        if m and all((p / f).exists() for f in _FILES):
+        if not m:
+            continue
+        if (p / _MANIFEST).exists() \
+                or all((p / f).exists() for f in _FILES):
             found.append((int(m.group(1)), p))
-    for _, p in sorted(found)[:-keep or None]:
+    return sorted(found)
+
+
+def prune(ckpt_dir, keep: int, trusted=None) -> None:
+    """Delete all COMPLETE `ckpt_N` directories except the `keep`
+    highest-epoch ones (rotation — a long elastic run otherwise
+    accumulates multi-GB checkpoints without bound), but NEVER the
+    newest *verified* checkpoint: if everything newer is corrupt, the
+    one restorable state must survive rotation, whatever its age.
+    `trusted`: a path this process just wrote and hashed (the save
+    path passes its own fresh checkpoint) — taken as verified without
+    re-reading every npz it fsync'd milliseconds ago. `.tmp` leftovers
+    and foreign names are untouched. Process-0-only by construction
+    (called from the write path)."""
+    assert keep >= 1, f"prune keeps at least one checkpoint, got {keep}"
+    found = _candidates(ckpt_dir)
+    doomed = found[:-keep or None]
+    if doomed:
+        trusted = Path(trusted) if trusted is not None else None
+        for _, p in reversed(found):
+            if p == trusted or is_verified(p):
+                doomed = [(e, q) for e, q in doomed if q != p]
+                break
+    for _, p in doomed:
         shutil.rmtree(p, ignore_errors=True)
 
 
@@ -369,20 +549,29 @@ class AsyncSaver:
         self._raise_pending()
 
 
+def has_checkpoint(ckpt_dir) -> bool:
+    """Whether any complete-looking checkpoint exists — a cheap
+    existence probe (no hashing). The auto-resume gate uses this and
+    leaves verification/quarantine/fallback to `restore_latest`, so
+    the newest multi-GB checkpoint is hashed once at restore, not
+    twice (the re-hash would inflate measured restart downtime)."""
+    return bool(_candidates(ckpt_dir))
+
+
 def latest(ckpt_dir) -> Path | None:
-    """Highest-epoch COMPLETE checkpoint directory (ignores `.tmp` leftovers,
-    foreign `ckpt_*` names, and dirs missing either npz)."""
-    d = Path(ckpt_dir)
-    if not d.exists():
-        return None
-    best, best_epoch = None, -1
-    for p in d.iterdir():
-        m = re.fullmatch(r"ckpt_(\d+)", p.name)
-        if not m or not all((p / f).exists() for f in _FILES):
-            continue
-        if int(m.group(1)) > best_epoch:
-            best, best_epoch = p, int(m.group(1))
-    return best
+    """Highest-epoch VERIFIED checkpoint directory (ignores `.tmp`
+    leftovers, foreign `ckpt_*` names, and incomplete dirs). A complete
+    dir that fails manifest verification is quarantined as
+    `ckpt_N.corrupt` on the spot and the scan falls back to the next
+    newest — `latest()` never hands out a checkpoint whose bytes don't
+    match their recorded hashes. (Multi-process: the quarantine rename
+    races benignly — one process wins, the others' rename fails and
+    their next scan no longer sees the dir.)"""
+    for _, p in reversed(_candidates(ckpt_dir)):
+        if is_verified(p):
+            return p
+        quarantine(p)
+    return None
 
 
 def _structure_mismatch(a, b) -> str | None:
@@ -404,7 +593,7 @@ def _restore_opt_canonical(engine, d: Path, opt_state, meta) -> bool:
     file). Returns True when the state was installed."""
     path = d / "opt_canon.npz"
     if path.exists():
-        canon, cmeta = load_pytree(path, with_meta=True)
+        canon, cmeta = _load_checked(path, with_meta=True)
         src_kind = cmeta.get("optimizer")
     elif meta.get("opt_is_canonical"):
         canon, src_kind = opt_state, meta.get("optimizer")
@@ -429,14 +618,37 @@ def _restore_opt_canonical(engine, d: Path, opt_state, meta) -> bool:
     return True
 
 
+def _load_checked(path, with_meta: bool = False):
+    """load_pytree with every load-path failure — truncated zip, bad
+    JSON spec, missing members, IO errors — wrapped into the one typed
+    CheckpointError carrying the offending path. Callers never see a
+    raw zipfile.BadZipFile."""
+    import zipfile
+
+    try:
+        return load_pytree(path, with_meta=with_meta)
+    except (OSError, ValueError, KeyError, EOFError,
+            zipfile.BadZipFile, json.JSONDecodeError) as e:
+        # np.load raises OSError on short reads, ValueError on pickle
+        # refusal, and lets zipfile.BadZipFile escape on a mangled
+        # archive — all of them become the one typed error here
+        raise CheckpointError(
+            f"checkpoint file {path} failed to load "
+            f"({type(e).__name__}: {e})", path=path) from e
+
+
 def restore(engine, ckpt_path) -> int:
     """Load a checkpoint into `engine` (any kind). Returns the next epoch.
 
-    Params are validated (structure + shapes) against the engine's model
-    config before installation; a mismatch raises instead of silently
-    installing corrupted weights. Optimizer state restores only when its
-    pytree matches the engine's (same kind AND same topology — opt state is
-    engine-shaped, e.g. stacked per-stage for the SPMD engine).
+    The manifest is verified BEFORE anything is installed (a corrupt
+    checkpoint raises CheckpointError — quarantine-and-fall-back is
+    `restore_latest`'s job), and every load failure is wrapped into
+    CheckpointError with the offending path. Params are additionally
+    validated (structure + shapes) against the engine's model config; a
+    mismatch raises ValueError instead of silently installing wrong
+    weights. Optimizer state restores only when its pytree matches the
+    engine's (same kind AND same topology — opt state is engine-shaped,
+    e.g. stacked per-stage for the SPMD engine).
     """
     d = Path(ckpt_path)
     if not (d / "params.npz").exists():
@@ -450,15 +662,16 @@ def restore(engine, ckpt_path) -> int:
                     f"process 0 — the checkpoint dir must live on a "
                     f"filesystem ALL hosts mount (see _write_ckpt's "
                     f"shared-filesystem contract)")
-        raise FileNotFoundError(msg)
-    params = load_pytree(d / "params.npz")
+        raise CheckpointError(msg, path=d / "params.npz")
+    verify(d)
+    params = _load_checked(d / "params.npz")
     mismatch = _structure_mismatch(params, engine.get_canonical_params())
     if mismatch is not None:
         raise ValueError(
             f"checkpoint {d} does not match this engine's model config "
             f"({mismatch}); refusing to restore")
     engine.set_canonical_params(params)
-    opt_state, meta = load_pytree(d / "opt.npz", with_meta=True)
+    opt_state, meta = _load_checked(d / "opt.npz", with_meta=True)
     if (meta["engine"] == type(engine).__name__
             and _structure_mismatch(opt_state, engine.opt_state) is None):
         engine.set_opt_state(opt_state)
@@ -479,3 +692,38 @@ def restore(engine, ckpt_path) -> int:
         # an uninterrupted run would (train_lm's exact-resume contract)
         engine._step_count = nxt
     return nxt
+
+
+def restore_latest(engine, ckpt_dir
+                   ) -> tuple[int, Path | None, list[Path]]:
+    """Restore the newest checkpoint that both verifies AND loads,
+    quarantining every one that doesn't and falling back — the recovery
+    path `--auto-resume` rides after a corruption fault. Returns
+    `(next_epoch, restored_path, quarantined_paths)`; `(0, None, [...])`
+    when nothing restorable remains. Config mismatches (ValueError)
+    still propagate: a wrong --resume target is a user error, not
+    corruption to quarantine."""
+    quarantined: list[Path] = []
+    while True:
+        cands = _candidates(ckpt_dir)
+        if not cands:
+            return 0, None, quarantined
+        _, ck = cands[-1]
+        try:
+            return restore(engine, ck), ck, quarantined
+        except CheckpointError as e:
+            # covers manifest-verification failures AND unloadable
+            # trees in legacy (no-manifest) dirs: same treatment
+            warnings.warn(f"restore of {ck} failed ({e}); quarantining "
+                          f"and falling back")
+            q = quarantine(ck)
+            if q is not None:
+                quarantined.append(q)
+            elif ck.exists():
+                # the dir is still there but could not be renamed (a
+                # read-only FS): bail rather than spin on the same dir
+                return 0, None, quarantined
+            # else: a peer process won the quarantine race and the dir
+            # is gone — rescan and keep falling back like the peer did
+            # (returning (0, None) here would silently start THIS gang
+            # member fresh while its peers resumed from a checkpoint)

@@ -90,6 +90,12 @@ class Dataset:
 
     def load_batch(self, batch_id: int) -> tuple[np.ndarray, np.ndarray]:
         """The whole local batch: (local_bs, 784), (local_bs, 10)."""
+        from shallowspeed_tpu import chaos
+
+        # chaos stall fault (fires at most once per plan): a wedged
+        # data loader must surface in the goodput ledger / hang
+        # detection, not silently stretch the epoch time
+        chaos.on_data_load(batch_id)
         s = slice(batch_id * self._local_bs, (batch_id + 1) * self._local_bs)
         return self.input_X[s], self.target_Y[s]
 

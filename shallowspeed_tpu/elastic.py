@@ -25,6 +25,17 @@ pieces:
   killed and the restart policy takes over — covering wedged device
   queues / deadlocked input pipelines that would never exit on their
   own.
+- **Failure-class supervision** (round 10): every failure is classed
+  (crash / hang / numeric / corrupt_ckpt — see `FAIL_CLASSES`), each
+  class backs off on its own jittered exponential stream, the SAME
+  step failing twice in a row is flagged as a poison step (labeled
+  abort + forensic snapshot instead of a budget-burning crash loop),
+  kills are SIGTERM-with-grace before SIGKILL so the child can flush
+  its ledger tail, and each detection-to-respawn interval is stamped
+  into the goodput ledger with its class — `--goodput` reduces those
+  stamps to per-class MTTR and run availability. `--chaos` exports a
+  deterministic fault plan (`shallowspeed_tpu.chaos`) to the children
+  for staging drills of exactly this machinery.
 
 CLI:
 
@@ -40,13 +51,31 @@ is on and the command does not already carry one.
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import random
 import signal
 import subprocess
 import sys
 import tempfile
 import time
 from dataclasses import dataclass, field
+
+# Failure classes the supervisor distinguishes (round 10). Each class
+# has its own detection signal, its own backoff stream, and its own
+# MTTR bucket in the goodput ledger:
+#   crash        child exited nonzero (or died to an outside signal)
+#   hang         heartbeat mtime went stale -> we killed it
+#   numeric      heartbeat status said "dead <reason>" -> we killed it
+#   corrupt_ckpt child exited EXIT_CORRUPT_CKPT (restore found no
+#                verified checkpoint under a strict --resume)
+FAIL_CLASSES = ("crash", "hang", "numeric", "corrupt_ckpt")
+
+# Exit-code convention between the drivers and the supervisor: a child
+# that cannot restore ANY verified checkpoint exits with EX_DATAERR so
+# the supervisor can class the failure as checkpoint corruption rather
+# than a generic crash.
+EXIT_CORRUPT_CKPT = 65
 
 
 # --------------------------------------------------- heartbeat status
@@ -61,6 +90,24 @@ from dataclasses import dataclass, field
 # steadily (the loop is not hung), so the hang timeout would never
 # fire, and every further step is wasted work. Plain `touch`ed (empty)
 # heartbeat files remain valid "ok" beats.
+
+
+def install_sigterm_exit() -> bool:
+    """Driver-side half of the --term-grace contract: convert SIGTERM
+    into SystemExit(143) so the training loop's finally blocks run —
+    the prefetcher closes, the tracer flushes, and the metrics JSONL
+    tail (the goodput ledger the reducer reads) lands on disk — before
+    the supervisor's SIGKILL deadline. Returns False outside the main
+    thread (signal handlers are main-thread-only), where the default
+    terminate semantics stand."""
+    def _to_exit(signum, frame):
+        raise SystemExit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, _to_exit)
+        return True
+    except ValueError:
+        return False
 
 
 def _argv_log_file(argv: list[str]) -> str | None:
@@ -94,38 +141,60 @@ def read_heartbeat_status(path) -> str:
 
 @dataclass
 class RestartPolicy:
-    """Budgeted restarts with exponential backoff.
+    """Budgeted restarts with per-failure-class jittered exponential
+    backoff.
 
-    `max_restarts` failures are tolerated; each backoff doubles from
-    `backoff` up to `backoff_max`. A child that stayed up longer than
-    `healthy_after` seconds refills the budget and resets the backoff —
-    a long-running job that hits one bad preemption a day should never
-    exhaust its budget."""
+    `max_restarts` failures are tolerated (one shared budget — a run
+    dying N ways is still dying); each class's backoff doubles
+    independently from `backoff` up to `backoff_max`, so one slow-to-
+    detect hang does not inflate the next crash's restart latency.
+    `jitter` stretches each delay by up to that fraction, drawn from a
+    seeded stream (deterministic for tests, decorrelated across
+    supervisors in a fleet — the thundering-herd standard). A child
+    that stayed up longer than `healthy_after` seconds refills the
+    budget and resets every backoff — a long-running job that hits one
+    bad preemption a day should never exhaust its budget."""
 
     max_restarts: int = 3
     backoff: float = 5.0
     backoff_max: float = 300.0
     healthy_after: float = 600.0
+    jitter: float = 0.0
+    seed: int = 0
 
     _used: int = field(default=0, init=False)
     _next_backoff: float = field(default=0.0, init=False)
+    _class_backoff: dict = field(default_factory=dict, init=False)
+    _rng: random.Random = field(default=None, init=False)
 
     def __post_init__(self):
         self._next_backoff = self.backoff
+        self._rng = random.Random(self.seed)
 
     def record_run(self, run_seconds: float) -> None:
         if run_seconds >= self.healthy_after:
             self._used = 0
             self._next_backoff = self.backoff
+            self._class_backoff.clear()
 
-    def next_restart(self) -> float | None:
+    def next_restart(self, fail_class: str | None = None
+                     ) -> float | None:
         """Delay before the next restart, or None when the budget is
-        exhausted."""
+        exhausted. With a `fail_class`, the doubling is tracked per
+        class; without one, the legacy shared stream is used."""
         if self._used >= self.max_restarts:
             return None
         self._used += 1
-        delay = self._next_backoff
-        self._next_backoff = min(self._next_backoff * 2, self.backoff_max)
+        if fail_class is None:
+            delay = self._next_backoff
+            self._next_backoff = min(self._next_backoff * 2,
+                                     self.backoff_max)
+        else:
+            delay = self._class_backoff.get(fail_class, self.backoff)
+            self._class_backoff[fail_class] = min(delay * 2,
+                                                  self.backoff_max)
+        if self.jitter:
+            delay *= 1.0 + self.jitter * self._rng.random()
         return delay
 
 
@@ -137,12 +206,27 @@ class Supervisor:
                  hang_timeout: float | None = None,
                  heartbeat_file: str | None = None,
                  poll_interval: float = 1.0,
-                 log=print, ledger_file: str | None = None):
+                 log=print, ledger_file: str | None = None,
+                 term_grace: float = 5.0,
+                 child_env: dict | None = None):
         self.argv = list(argv)
         self.policy = policy or RestartPolicy()
         self.hang_timeout = hang_timeout
         self.poll_interval = poll_interval
         self.log = log
+        # kill path (round 10): SIGTERM with a grace window before
+        # SIGKILL, so the child's handler can flush its metrics-JSONL
+        # tail (the goodput ledger the reducer reads) — a bare
+        # hang-SIGKILL used to truncate it mid-teardown. 0 disables.
+        self.term_grace = term_grace
+        # extra child environment (the chaos plan's env propagation)
+        self.child_env = dict(child_env or {})
+        # poison-step detection: the SAME step failing twice in a row
+        # is a deterministic crash, not an infrastructure blip —
+        # restarting would burn the whole budget replaying into the
+        # same wall
+        self._poison_step: int | None = None
+        self._poison_count = 0
         # goodput ledger (round 9): restart downtime is stamped into
         # the SAME metrics JSONL the child writes, so the goodput
         # reducer sees the whole history in one file. Default: the
@@ -162,8 +246,31 @@ class Supervisor:
 
     # ------------------------------------------------------------ child
 
-    def _run_once(self) -> tuple[int, float]:
-        """One child run. Returns (exit code, run seconds); a hang kill
+    def _terminate(self, child) -> None:
+        """SIGTERM, wait `term_grace` seconds for a voluntary exit (the
+        drivers convert SIGTERM to SystemExit so their finally blocks
+        flush the ledger tail), then SIGKILL what remains."""
+        if child.poll() is not None:
+            return
+        if self.term_grace and self.term_grace > 0:
+            child.send_signal(signal.SIGTERM)
+            try:
+                child.wait(timeout=self.term_grace)
+                return
+            except subprocess.TimeoutExpired:
+                pass
+        child.send_signal(signal.SIGKILL)
+        child.wait()
+
+    def _spawn(self, argv):
+        self._mark_log()
+        env = ({**os.environ, **self.child_env} if self.child_env
+               else None)
+        return subprocess.Popen(argv, env=env)
+
+    def _run_once(self) -> tuple[int, float, str | None]:
+        """One child run. Returns (exit code, run seconds, failure
+        class) — class None on a clean exit; a hang/health kill
         reports exit code -9."""
         t0 = time.monotonic()
         if self.heartbeat_file:
@@ -176,7 +283,7 @@ class Supervisor:
                 write_heartbeat(self.heartbeat_file, "ok")
             except OSError:
                 pass
-        child = subprocess.Popen(self.argv)
+        child = self._spawn(self.argv)
         # staleness floor: if the heartbeat file disappears mid-run
         # (deleted, tmpfs wipe), measure staleness from the last KNOWN
         # beat — child start at worst — instead of silently disabling
@@ -185,7 +292,10 @@ class Supervisor:
         while True:
             code = child.poll()
             if code is not None:
-                return code, time.monotonic() - t0
+                cls = (None if code == 0
+                       else "corrupt_ckpt" if code == EXIT_CORRUPT_CKPT
+                       else "crash")
+                return code, time.monotonic() - t0, cls
             if self.heartbeat_file:
                 status = read_heartbeat_status(self.heartbeat_file)
                 if status.startswith("dead"):
@@ -197,9 +307,8 @@ class Supervisor:
                     self.log(f"[elastic] health verdict {status!r} — "
                              f"killing child {child.pid} for a "
                              f"checkpoint restart")
-                    child.send_signal(signal.SIGKILL)
-                    child.wait()
-                    return -9, time.monotonic() - t0
+                    self._terminate(child)
+                    return -9, time.monotonic() - t0, "numeric"
             if self.hang_timeout is not None:
                 try:
                     hb_seen = max(hb_seen,
@@ -211,9 +320,8 @@ class Supervisor:
                     self.log(f"[elastic] heartbeat stale {stale:.0f}s > "
                              f"{self.hang_timeout}s — killing child "
                              f"{child.pid}")
-                    child.send_signal(signal.SIGKILL)
-                    child.wait()
-                    return -9, time.monotonic() - t0
+                    self._terminate(child)
+                    return -9, time.monotonic() - t0, "hang"
             time.sleep(self.poll_interval)
 
     # ------------------------------------------------------------- loop
@@ -236,39 +344,143 @@ class Supervisor:
         finally:
             self._cleanup_heartbeats()
 
+    def _last_logged_step(self) -> int | None:
+        """The last step THIS child's metrics JSONL stanza recorded —
+        the poison-step detector's evidence. Reads only lines written
+        after the child spawned (`_log_mark`, set at spawn): a
+        replacement that died during init, before logging anything new,
+        must read as 'no step', not as a repeat of its predecessor's
+        last step — otherwise a preemption storm looks like a poison
+        step and gets a spurious permanent abort."""
+        if not self.ledger_file:
+            return None
+        mark = getattr(self, "_log_mark", 0)
+        try:
+            with open(self.ledger_file, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 262144, mark))
+                tail = f.read().decode(errors="replace")
+        except OSError:
+            return None
+        step = None
+        for line in tail.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("event") == "step" \
+                    and isinstance(rec.get("step"), int):
+                step = rec["step"]
+        return step
+
+    def _mark_log(self) -> None:
+        """Remember where the metrics file ends as this child spawns —
+        the poison detector only credits lines written after this."""
+        try:
+            self._log_mark = (os.path.getsize(self.ledger_file)
+                              if self.ledger_file else 0)
+        except OSError:
+            self._log_mark = 0
+
+    def _check_poison(self) -> int | None:
+        """Track the step each failed child died at; the same step
+        twice IN A ROW means the crash is deterministic (a poison
+        batch / poisoned state) — replaying it a third time would just
+        burn the budget into the same wall. Returns the poison step."""
+        step = self._last_logged_step()
+        if step is not None and step == self._poison_step:
+            self._poison_count += 1
+        else:
+            self._poison_step, self._poison_count = step, 1
+        if step is not None and self._poison_count >= 2:
+            return step
+        return None
+
+    def _stamp(self, kind: str, **fields) -> None:
+        if self.ledger_file:
+            from shallowspeed_tpu.telemetry.goodput import (
+                stamp_ledger_line)
+
+            stamp_ledger_line(self.ledger_file, kind, **fields)
+
+    def _forensics(self, step: int, fail_class, code) -> str | None:
+        """Freeze the evidence of a poison-step abort next to the
+        metrics file: what step, what class, what the log tail said —
+        the thing an on-call human wants BEFORE the next restart
+        overwrites the scene."""
+        if not self.ledger_file:
+            return None
+        path = f"{self.ledger_file}.poison_step_{step}.json"
+        tail = ""
+        try:
+            with open(self.ledger_file, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - 16384))
+                tail = f.read().decode(errors="replace")
+        except OSError:
+            pass
+        hb = (read_heartbeat_status(self.heartbeat_file)
+              if self.heartbeat_file else None)
+        try:
+            with open(path, "w") as f:
+                json.dump({"poison_step": step,
+                           "fail_class": fail_class,
+                           "exit_code": code,
+                           "argv": self.argv,
+                           "heartbeat_status": hb,
+                           "metrics_tail": tail.splitlines()[-40:]},
+                          f, indent=1)
+        except OSError:
+            return None
+        return path
+
     def _supervise(self) -> int:
         attempt = 0
         while True:
             attempt += 1
             self.log(f"[elastic] attempt {attempt}: {' '.join(self.argv)}")
-            code, secs = self._run_once()
+            code, secs, fail_class = self._run_once()
             t_dead = time.monotonic()
             if code == 0:
                 self.log(f"[elastic] child finished cleanly after "
                          f"{secs:.0f}s")
                 return 0
             self.policy.record_run(secs)
-            delay = self.policy.next_restart()
-            if delay is None:
-                self.log(f"[elastic] child failed (exit {code}) and the "
-                         f"restart budget is exhausted; giving up")
+            poison = self._check_poison()
+            if poison is not None:
+                # deterministic failure: label it, freeze forensics,
+                # abort — do NOT burn the budget in a crash loop
+                snap = self._forensics(poison, fail_class, code)
+                self.log(f"[elastic] poison step {poison}: the same "
+                         f"step failed twice in a row (class "
+                         f"{fail_class}, exit {code}) — aborting"
+                         + (f"; forensic snapshot {snap}" if snap
+                            else ""))
+                self._stamp("poison_step_abort", step=poison,
+                            fail_class=fail_class, exit_code=code)
                 return code if code > 0 else 1
-            self.log(f"[elastic] child failed (exit {code}) after "
-                     f"{secs:.0f}s; restarting in {delay:.1f}s")
+            delay = self.policy.next_restart(fail_class)
+            if delay is None:
+                self.log(f"[elastic] child failed (exit {code}, class "
+                         f"{fail_class}) and the restart budget is "
+                         f"exhausted; giving up")
+                self._stamp("supervisor_abort", fail_class=fail_class,
+                            exit_code=code)
+                return code if code > 0 else 1
+            self.log(f"[elastic] child failed (exit {code}, class "
+                     f"{fail_class}) after {secs:.0f}s; restarting in "
+                     f"{delay:.1f}s")
             time.sleep(delay)
-            if self.ledger_file:
-                # stamp the restart downtime (kill-to-respawn, i.e.
-                # backoff + detection latency) into the child's
-                # metrics JSONL — goodput.run_goodput itemizes it, and
-                # cross-checks it against the wall gap the child
-                # stanzas themselves show
-                from shallowspeed_tpu.telemetry.goodput import (
-                    stamp_ledger_line)
-
-                stamp_ledger_line(
-                    self.ledger_file, "restart_downtime",
-                    seconds=round(time.monotonic() - t_dead, 3),
-                    attempt=attempt, exit_code=code)
+            # stamp the restart downtime (detection-to-respawn: kill
+            # latency + backoff) into the child's metrics JSONL —
+            # goodput.run_goodput itemizes it, cross-checks it against
+            # the wall gap the child stanzas themselves show, and
+            # reduces the per-class stamps to MTTR figures
+            self._stamp("restart_downtime",
+                        seconds=round(time.monotonic() - t_dead, 3),
+                        attempt=attempt, exit_code=code,
+                        fail_class=fail_class)
 
 
 class GangSupervisor(Supervisor):
@@ -299,7 +511,9 @@ class GangSupervisor(Supervisor):
                  hang_timeout: float | None = None,
                  coordinator: str | None = None,
                  poll_interval: float = 1.0, log=print,
-                 ledger_file: str | None = None):
+                 ledger_file: str | None = None,
+                 term_grace: float = 5.0,
+                 child_env: dict | None = None):
         # deliberately NOT calling super().__init__: the heartbeat is
         # per-child here (N files, injected per process)
         self.argv = list(argv)
@@ -310,6 +524,11 @@ class GangSupervisor(Supervisor):
         self.coordinator = coordinator
         self.poll_interval = poll_interval
         self.log = log
+        self.term_grace = term_grace
+        self.child_env = dict(child_env or {})
+        self.heartbeat_file = None  # per-member files; see below
+        self._poison_step = None
+        self._poison_count = 0
         # gang note: a shared --log-file would interleave N processes'
         # stanzas; restart stamps still help process 0's file
         self.ledger_file = ledger_file or _argv_log_file(self.argv)
@@ -340,14 +559,28 @@ class GangSupervisor(Supervisor):
             return s.getsockname()[1]
 
     def _kill_gang(self, children) -> None:
+        """SIGTERM the whole gang at once, give every member the one
+        shared grace window to flush, then SIGKILL the stragglers."""
+        live = [c for c in children if c.poll() is None]
+        if self.term_grace and self.term_grace > 0:
+            for c in live:
+                c.send_signal(signal.SIGTERM)
+            deadline = time.monotonic() + self.term_grace
+            for c in live:
+                try:
+                    c.wait(timeout=max(0.05,
+                                       deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    pass
         for c in children:
             if c.poll() is None:
                 c.send_signal(signal.SIGKILL)
         for c in children:
             c.wait()
 
-    def _run_once(self) -> tuple[int, float]:
+    def _run_once(self) -> tuple[int, float, str | None]:
         t0 = time.monotonic()
+        self._mark_log()  # poison detector: credit only this gang's lines
         coord = self.coordinator or f"localhost:{self._free_port()}"
         children = []
         # any exception ANYWHERE here (failed spawn, SIGINT in the
@@ -366,7 +599,7 @@ class GangSupervisor(Supervisor):
                     except OSError:
                         pass
                     argv += ["--heartbeat-file", self.heartbeat_files[i]]
-                env = {**os.environ,
+                env = {**os.environ, **self.child_env,
                        "JAX_COORDINATOR_ADDRESS": coord,
                        "JAX_NUM_PROCESSES": str(self.n),
                        "JAX_PROCESS_ID": str(i)}
@@ -380,9 +613,12 @@ class GangSupervisor(Supervisor):
                     self.log(f"[elastic] gang member {bad} exited "
                              f"{codes[bad]} — killing the gang")
                     self._kill_gang(children)
-                    return codes[bad], time.monotonic() - t0
+                    cls = ("corrupt_ckpt"
+                           if codes[bad] == EXIT_CORRUPT_CKPT
+                           else "crash")
+                    return codes[bad], time.monotonic() - t0, cls
                 if all(c == 0 for c in codes):
-                    return 0, time.monotonic() - t0
+                    return 0, time.monotonic() - t0, None
                 if self.hang_timeout is not None:
                     for i, hb in enumerate(self.heartbeat_files):
                         if codes[i] == 0:
@@ -394,7 +630,7 @@ class GangSupervisor(Supervisor):
                                      f"killing the gang for a "
                                      f"checkpoint restart")
                             self._kill_gang(children)
-                            return -9, time.monotonic() - t0
+                            return -9, time.monotonic() - t0, "numeric"
                         try:
                             hb_seen[i] = max(hb_seen[i],
                                              os.path.getmtime(hb))
@@ -407,7 +643,7 @@ class GangSupervisor(Supervisor):
                                      f"{self.hang_timeout}s — killing "
                                      f"the gang")
                             self._kill_gang(children)
-                            return -9, time.monotonic() - t0
+                            return -9, time.monotonic() - t0, "hang"
                 time.sleep(self.poll_interval)
         except BaseException:
             self._kill_gang(children)
@@ -427,6 +663,24 @@ def main(argv=None) -> int:
     ap.add_argument("--hang-timeout", type=float, default=None,
                     help="kill the child if its heartbeat file goes "
                          "stale this long (seconds)")
+    ap.add_argument("--term-grace", type=float, default=5.0,
+                    help="kill path: SIGTERM first and wait this long "
+                         "for the child to flush its metrics/ledger "
+                         "tail before SIGKILL (0 = straight SIGKILL)")
+    ap.add_argument("--jitter", type=float, default=0.1,
+                    help="stretch each restart backoff by up to this "
+                         "fraction (seeded; decorrelates a fleet of "
+                         "supervisors restarting off one outage)")
+    ap.add_argument("--chaos", default="",
+                    help="fault-injection plan for the CHILDREN "
+                         "(shallowspeed_tpu.chaos DSL or JSON path), "
+                         "exported via SHALLOWSPEED_CHAOS — a staging "
+                         "drill of the recovery stack")
+    ap.add_argument("--chaos-state", default="",
+                    help="directory for the chaos plan's fired-fault "
+                         "markers; MUST survive restarts for faults "
+                         "to fire once per run (required with --chaos)")
+    ap.add_argument("--chaos-seed", type=int, default=0)
     ap.add_argument("--procs", type=int, default=1,
                     help="gang mode: launch N multi-controller "
                          "processes of the command (JAX_COORDINATOR_"
@@ -446,13 +700,35 @@ def main(argv=None) -> int:
         ap.error("no training command given (separate it with --)")
     policy = RestartPolicy(
         max_restarts=args.max_restarts, backoff=args.backoff,
-        backoff_max=args.backoff_max, healthy_after=args.healthy_after)
+        backoff_max=args.backoff_max, healthy_after=args.healthy_after,
+        # per-process entropy: N supervisors restarting off one shared
+        # outage must draw DIFFERENT jitter streams, or the jitter
+        # decorrelates nothing (a fixed default seed would re-sync the
+        # herd); tests that need determinism build RestartPolicy
+        # directly with an explicit seed
+        jitter=args.jitter, seed=os.getpid())
+    child_env = None
+    if args.chaos:
+        if not args.chaos_state:
+            ap.error("--chaos needs --chaos-state (fired-fault markers "
+                     "must survive restarts, or every restarted child "
+                     "re-fires every fault)")
+        from shallowspeed_tpu.chaos import FaultPlan
+
+        plan = FaultPlan.parse(args.chaos, seed=args.chaos_seed,
+                               state_dir=args.chaos_state)
+        child_env = {k: v for k, v in plan.export_env().items()
+                     if k.startswith("SHALLOWSPEED_CHAOS")}
     if args.procs > 1:
         sup = GangSupervisor(cmd, args.procs, policy,
                              hang_timeout=args.hang_timeout,
-                             coordinator=args.coordinator)
+                             coordinator=args.coordinator,
+                             term_grace=args.term_grace,
+                             child_env=child_env)
     else:
-        sup = Supervisor(cmd, policy, hang_timeout=args.hang_timeout)
+        sup = Supervisor(cmd, policy, hang_timeout=args.hang_timeout,
+                         term_grace=args.term_grace,
+                         child_env=child_env)
     return sup.run()
 
 

@@ -35,7 +35,9 @@ def main(argv=None) -> int:
                         "below the prior rounds beyond the noise band")
     g.add_argument("--goodput", metavar="JSONL",
                    help="reduce one metrics JSONL to the goodput "
-                        "report (wall-clock decomposition + losses)")
+                        "report (wall-clock decomposition + losses, "
+                        "per-failure-class MTTR, availability, and "
+                        "the injected-fault tally on chaos drills)")
     args = p.parse_args(argv)
 
     if args.regress:

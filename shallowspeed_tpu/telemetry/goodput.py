@@ -30,6 +30,14 @@ Two classes of ledger kind:
   is not productive (data_stall seconds; skipped_steps counts, priced
   at the steady per-step rate by the reducer; recompiles counts,
   itemized — their wall cost already shows in the step rate).
+
+Schema v5 (round 10): the supervisor's restart stamps carry the
+failure class it diagnosed (crash / hang / numeric / corrupt_ckpt),
+and the reducer folds them into per-class **MTTR** (mean
+detection-to-respawn seconds) plus run **availability**
+(1 - downtime/wall); injected chaos faults (`"fault"` events,
+shallowspeed_tpu/chaos.py) are tallied alongside so a drill's report
+names what was injected next to what it cost.
 """
 
 from __future__ import annotations
@@ -142,6 +150,35 @@ def run_goodput(path) -> dict:
     counts: dict[str, int] = {"restarts": max(0, len(stanzas) - 1),
                               "replayed_steps": 0, "skipped_steps": 0,
                               "recompiles": 0}
+    # MTTR per failure class (schema v5): the supervisor's
+    # restart_downtime stamps each carry the class it diagnosed and
+    # the detection-to-respawn seconds it measured directly — reduce
+    # them to count/total/mean per class. Fault-injection stamps are
+    # tallied alongside so a chaos drill's report names what was
+    # injected next to what it cost.
+    mttr: dict[str, dict] = {}
+    faults: dict[str, int] = {}
+    for rec in recs:
+        if rec.get("event") == "fault" and isinstance(rec.get("kind"),
+                                                      str):
+            faults[rec["kind"]] = faults.get(rec["kind"], 0) + 1
+        if rec.get("event") != "ledger":
+            continue
+        if rec.get("kind") in ("restart_downtime", "poison_step_abort",
+                               "supervisor_abort") \
+                and isinstance(rec.get("fail_class"), str):
+            cls = rec["fail_class"]
+            m = mttr.setdefault(cls, {"count": 0, "total_s": 0.0})
+            if rec.get("kind") == "restart_downtime":
+                m["count"] += 1
+                if isinstance(rec.get("seconds"), (int, float)):
+                    m["total_s"] += float(rec["seconds"])
+            else:
+                m[rec["kind"]] = m.get(rec["kind"], 0) + 1
+    for m in mttr.values():
+        m["total_s"] = round(m["total_s"], 3)
+        m["mttr_s"] = (round(m["total_s"] / m["count"], 3)
+                       if m["count"] else None)
 
     def add_loss(kind, secs):
         if secs > 0:
@@ -255,14 +292,22 @@ def run_goodput(path) -> dict:
     wall = (last - first) if first is not None and last is not None \
         else 0.0
     accounted = productive + sum(losses.values())
+    downtime = losses.get("restart_downtime", 0.0)
     return {
         "wall_clock_s": round(wall, 3),
         "productive_s": round(productive, 3),
         "goodput": round(productive / wall, 4) if wall > 0 else None,
+        # availability = the run was UP (stepping or pausing inside a
+        # live process), as opposed to down between a failure and its
+        # recovered successor — the SLA-shaped number MTTR feeds
+        "availability": (round(1.0 - min(wall, downtime) / wall, 4)
+                         if wall > 0 else None),
         "accounted_frac": (round(min(1.0, accounted / wall), 4)
                            if wall > 0 else None),
         "losses": {k: round(v, 3) for k, v in sorted(losses.items())},
         "counts": counts,
+        "mttr": mttr,
+        "faults": faults,
         "per_step_s": (round(per_step, 6) if per_step is not None
                        else None),
         "stanzas": len(stanzas),
@@ -284,6 +329,17 @@ def format_report(rep: dict) -> str:
     extra = {k: v for k, v in rep["counts"].items() if v}
     if extra:
         lines.append(f"counts: {extra}")
+    for cls, m in sorted(rep.get("mttr", {}).items()):
+        aborts = {k: v for k, v in m.items()
+                  if k.endswith("_abort") and v}
+        lines.append(
+            f"mttr[{cls:<12}] {m['count']} recover(ies), mean "
+            f"{m['mttr_s'] if m['mttr_s'] is not None else '—'} s"
+            + (f"  {aborts}" if aborts else ""))
+    if rep.get("faults"):
+        lines.append(f"injected faults: {rep['faults']}")
+    if rep.get("availability") is not None:
+        lines.append(f"availability {rep['availability']:.2%}")
     lines.append(f"accounted {rep['accounted_frac'] if rep['accounted_frac'] is not None else '—'}"
                  f" of wall clock over {rep['stanzas']} process(es)")
     return "\n".join(lines)

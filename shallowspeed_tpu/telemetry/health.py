@@ -373,3 +373,12 @@ class HealthMonitor:
 
     def heartbeat_status(self) -> str:
         return f"dead {self.dead_reason}" if self.dead_reason else "ok"
+
+    def unhealthy(self) -> bool:
+        """Whether the run's state, as of the last observed pack, is
+        one a checkpoint must NOT capture: non-finite gradients or a
+        dead verdict. The drivers gate saves on this — checkpointing a
+        poisoned iterate would turn the recovery stack's restore point
+        into the very state it needs to recover FROM (found by the
+        round-10 chaos NaN-storm drill)."""
+        return bool(self.dead_reason) or self._consec_nonfinite > 0

@@ -32,11 +32,16 @@ from pathlib import Path
 # ledger (`"ledger"` events, `telemetry/goodput.py`) and the absolute
 # `wall` timestamp every metrics line now carries so the ledger
 # reducer can account wall clock ACROSS process restarts. Writers
-# stamp it on their run_start line (metrics.MetricsLogger); the
-# validator accepts ALL dialects — every versioned field is optional,
-# so committed v1/v2/v3 artifacts (no version stamp / no health /
-# overlap / attrib / wall fields) keep validating unchanged.
-SCHEMA_VERSION = 4
+# stamp it on their run_start line (metrics.MetricsLogger); 5 = v4
+# plus the chaos/recovery extension (`shallowspeed_tpu/chaos.py`,
+# round 10): `"fault"` events stamped at every injected fault, and
+# the `fail_class` field on supervisor-stamped ledger lines
+# (restart_downtime / poison_step_abort / supervisor_abort) that the
+# goodput reducer turns into per-failure-class MTTR. The validator
+# accepts ALL dialects — every versioned field is optional, so
+# committed v1-v4 artifacts (no version stamp / no health / overlap /
+# attrib / wall / fault fields) keep validating unchanged.
+SCHEMA_VERSION = 5
 
 _NUM = (int, float)
 
@@ -58,10 +63,20 @@ _METRIC_EVENTS = {
     # schema v4: decode throughput + HBM-roofline line (models/
     # generate.decode_report via the LM driver)
     "generate": {"tokens_per_sec": _NUM},
+    # schema v5: chaos fault-injection stamps (shallowspeed_tpu/
+    # chaos.py) — the forensic record of what was injected when,
+    # fsync'd into the same JSONL the step lines live in
+    "fault": {"kind": str},
 }
 
-# optional typed fields on a "ledger" line
-_LEDGER_OPTIONAL = {"seconds": _NUM, "count": int}
+# optional typed fields on a "ledger" line (`fail_class`: the
+# supervisor's failure classification riding its restart stamps)
+_LEDGER_OPTIONAL = {"seconds": _NUM, "count": int, "fail_class": str}
+
+# optional typed fields on a "fault" line
+_FAULT_OPTIONAL = {"step": int, "save": int, "seconds": _NUM,
+                   "leaf": int, "fault_id": str, "point": str,
+                   "path": str, "mode": str}
 
 # telemetry fields a step line MAY carry; when present they must type
 _STEP_TELEMETRY = {
@@ -129,6 +144,12 @@ def _validate_metric(rec: dict) -> list[str]:
             if field in rec and (not isinstance(rec[field], typ)
                                  or isinstance(rec[field], bool)):
                 probs.append(f"ledger: field {field!r} is "
+                             f"{type(rec[field]).__name__}")
+    if ev == "fault":
+        for field, typ in _FAULT_OPTIONAL.items():
+            if field in rec and (not isinstance(rec[field], typ)
+                                 or isinstance(rec[field], bool)):
+                probs.append(f"fault: field {field!r} is "
                              f"{type(rec[field]).__name__}")
     # schema v4: any metrics line may carry an absolute `wall` stamp
     if "wall" in rec and not isinstance(rec["wall"], _NUM):

@@ -1,0 +1,560 @@
+"""Chaos-hardened recovery (`shallowspeed_tpu/chaos.py` + the
+checkpoint-integrity and failure-class-supervision layers it forces).
+
+Coverage map:
+- FaultPlan: DSL/JSON parsing, determinism, env propagation, once-only
+  firing across "restarts" (state-dir markers).
+- Checkpoint integrity: SHA-256 manifest write/verify, typed
+  CheckpointError (never a raw BadZipFile), quarantine + fall-back to
+  the newest verified checkpoint, retention that never deletes the
+  last verified one, legacy (pre-manifest) checkpoints still restore.
+- Save-atomicity torture: a child process SIGKILLed at seeded offsets
+  inside save (sync AND async) — `latest()` must only ever return a
+  manifest-verified checkpoint.
+- Injected faults: ENOSPC mid-save leaves `latest()` untouched;
+  post-hoc corruption is caught and quarantined; the NaN poison hits
+  one seeded leaf; the stall stamps data-loader seconds.
+- Goodput reducer: per-failure-class MTTR, availability, fault tally.
+- End-to-end: a fast deterministic canary (supervised run under
+  kill + corrupt + stall matches the fault-free oracle's final loss
+  exactly) in tier-1, and the full multi-fault acceptance run
+  (kill-in-save, corruption, NaN storm, data stall, heartbeat-freeze
+  hang) marked `slow`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu import chaos, checkpoint
+from shallowspeed_tpu.chaos import Fault, FaultPlan
+from shallowspeed_tpu.engine import FusedDPEngine
+from shallowspeed_tpu.models.mlp import MLPStage
+from shallowspeed_tpu.optim import SGD
+from shallowspeed_tpu.parallel.mesh import make_mesh
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_plan(monkeypatch):
+    """Chaos must never leak between tests (or in from the env)."""
+    for var in (chaos.ENV_SPEC, chaos.ENV_STATE, chaos.ENV_SEED):
+        monkeypatch.delenv(var, raising=False)
+    chaos.configure(None)
+    yield
+    chaos.configure(None)
+
+
+# ------------------------------------------------------------ fault plan
+
+
+def test_plan_parse_roundtrip():
+    p = FaultPlan.parse("kill@9,stall@5:0.5,corrupt@2:truncate,nan@3")
+    assert [f.kind for f in p.faults] == ["kill", "stall", "corrupt",
+                                          "nan"]
+    assert p.faults[1].arg == 0.5 and p.faults[2].arg == "truncate"
+    # the spec round-trips (what the supervisor exports to children)
+    assert FaultPlan.parse(p.to_spec()).to_spec() == p.to_spec()
+
+
+def test_plan_parse_json_and_file(tmp_path):
+    obj = {"seed": 7, "faults": [{"kind": "kill", "at": 2},
+                                 {"kind": "stall", "at": 1,
+                                  "arg": 0.25}]}
+    p = FaultPlan.parse(json.dumps(obj))
+    assert p.seed == 7 and p.faults[0].id == "kill@2"
+    f = tmp_path / "plan.json"
+    f.write_text(json.dumps(obj))
+    assert FaultPlan.parse(str(f)).to_spec() == p.to_spec()
+
+
+def test_plan_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("explode@3")
+    with pytest.raises(ValueError, match="not an integer"):
+        FaultPlan.parse("kill@soon")
+    with pytest.raises(ValueError, match="1-based save"):
+        FaultPlan.parse("enospc@0")
+    with pytest.raises(ValueError, match="corrupt mode"):
+        Fault("corrupt", 1, "scramble")
+    with pytest.raises(ValueError, match="empty"):
+        FaultPlan.parse("  ,  ")
+
+
+def test_env_export_and_setup_roundtrip(tmp_path, monkeypatch):
+    p = FaultPlan.parse("kill@4", seed=3, state_dir=tmp_path / "cs")
+    for k, v in p.export_env({}).items():
+        if k.startswith("SHALLOWSPEED_CHAOS"):
+            monkeypatch.setenv(k, v)
+    got = chaos.setup()  # no flag: adopt the supervisor's env
+    assert got is not None and got.to_spec() == "kill@4"
+    assert got.seed == 3 and got.state_dir == tmp_path / "cs"
+    # the --chaos flag wins over the env
+    flag = chaos.setup("stall@1:0.1", state_dir=tmp_path / "cs2")
+    assert flag.to_spec() == "stall@1:0.1"
+
+
+def test_faults_fire_once_across_restarts(tmp_path):
+    """The once-only contract every replay-equals-oracle claim rests
+    on: a fired fault's marker survives into a fresh plan object (a
+    restarted child) and suppresses re-firing."""
+    state = tmp_path / "cs"
+    p1 = FaultPlan.parse("stall@2:0.05", state_dir=state)
+    t0 = time.monotonic()
+    p1.on_data_load(2)
+    assert time.monotonic() - t0 >= 0.05  # slept
+    p2 = FaultPlan.parse("stall@2:0.05", state_dir=state)  # "restart"
+    assert p2.fired(p2.faults[0])
+    t0 = time.monotonic()
+    p2.on_data_load(2)
+    assert time.monotonic() - t0 < 0.04  # marker suppressed the sleep
+
+
+def test_fault_stamp_validates_as_schema_v5(tmp_path):
+    from shallowspeed_tpu.telemetry.schema import (SCHEMA_VERSION,
+                                                   validate_file)
+
+    assert SCHEMA_VERSION >= 5
+    log = tmp_path / "m.jsonl"
+    p = FaultPlan.parse("stall@1:0.01,freeze@2", log_file=log)
+    p.on_data_load(1)
+    p.on_step(2)
+    assert p.heartbeat_frozen()
+    assert validate_file(log) == []
+    kinds = [json.loads(l)["kind"] for l in log.read_text().splitlines()]
+    assert kinds == ["stall", "freeze"]
+
+
+def test_nan_poison_hits_one_seeded_leaf():
+    class Eng:
+        params = {"a": np.ones(3, np.float32),
+                  "b": np.ones(4, np.float32),
+                  "c": np.ones(5, np.float32)}
+
+    def poisoned(seed):
+        eng = Eng()
+        eng.params = {k: np.array(v) for k, v in Eng.params.items()}
+        FaultPlan.parse("nan@1", seed=seed).on_step(1, eng)
+        return sorted(k for k, v in eng.params.items()
+                      if not np.all(np.isfinite(v)))
+
+    first = poisoned(0)
+    assert len(first) == 1           # exactly one leaf poisoned
+    assert poisoned(0) == first      # seeded: same leaf every time
+    seeds = {tuple(poisoned(s)) for s in range(8)}
+    assert len(seeds) > 1            # the seed really picks the leaf
+
+
+# ------------------------------------------------- checkpoint integrity
+
+
+SIZES = [784, 16, 15, 10]
+
+
+def small_engine():
+    return FusedDPEngine(MLPStage(SIZES, 0, 1, batch_size=8), SGD(0.5),
+                         make_mesh(1, 1))
+
+
+def test_save_writes_manifest_and_verify_passes(tmp_path):
+    eng = small_engine()
+    ck = checkpoint.save(tmp_path, eng, epoch=0)
+    man = json.loads((ck / "manifest.json").read_text())
+    assert set(man["files"]) == {"params.npz", "opt.npz"}
+    assert all(len(rec["sha256"]) == 64 for rec in man["files"].values())
+    checkpoint.verify(ck)  # does not raise
+    assert checkpoint.is_verified(ck)
+
+
+@pytest.mark.parametrize("damage", ["bitflip", "truncate", "delete"])
+def test_verify_catches_each_corruption_mode(tmp_path, damage):
+    eng = small_engine()
+    ck = checkpoint.save(tmp_path, eng, epoch=0)
+    target = ck / "params.npz"
+    if damage == "bitflip":
+        raw = bytearray(target.read_bytes())
+        raw[len(raw) // 2] ^= 0x10
+        target.write_bytes(bytes(raw))
+    elif damage == "truncate":
+        target.write_bytes(target.read_bytes()[:100])
+    else:
+        target.unlink()
+    with pytest.raises(checkpoint.CheckpointError) as ei:
+        checkpoint.verify(ck)
+    assert ei.value.path == target
+
+
+def test_restore_raises_typed_error_never_bad_zipfile(tmp_path):
+    """A truncated npz must surface as CheckpointError carrying the
+    path — not zipfile.BadZipFile leaking out of np.load."""
+    eng = small_engine()
+    ck = checkpoint.save(tmp_path, eng, epoch=0)
+    (ck / "manifest.json").unlink()  # legacy dir: no manifest to catch it
+    (ck / "params.npz").write_bytes(b"PK\x03\x04 not a real zip")
+    with pytest.raises(checkpoint.CheckpointError) as ei:
+        checkpoint.restore(small_engine(), ck)
+    assert ei.value.path is not None
+    assert "params.npz" in str(ei.value.path)
+
+
+def test_latest_quarantines_and_falls_back(tmp_path):
+    eng = small_engine()
+    checkpoint.save(tmp_path, eng, epoch=1)
+    ck2 = checkpoint.save(tmp_path, eng, epoch=2)
+    raw = bytearray((ck2 / "opt.npz").read_bytes())
+    raw[-10] ^= 1
+    (ck2 / "opt.npz").write_bytes(bytes(raw))
+    with pytest.warns(UserWarning, match="quarantined"):
+        got = checkpoint.latest(tmp_path)
+    assert got.name == "ckpt_1"                  # fell back
+    assert (tmp_path / "ckpt_2.corrupt").exists()  # quarantined
+    assert not (tmp_path / "ckpt_2").exists()
+
+
+def test_restore_latest_quarantine_loop(tmp_path):
+    """restore_latest: corrupt newest + intact older -> the older one
+    is installed and the corrupt one quarantined; all corrupt -> (0,
+    None, [...]) so --auto-resume can fall back to a fresh start."""
+    eng = small_engine()
+    checkpoint.save(tmp_path, eng, epoch=0)
+    ck1 = checkpoint.save(tmp_path, eng, epoch=1)
+    (ck1 / "params.npz").write_bytes(b"garbage")
+    with pytest.warns(UserWarning, match="quarantined"):
+        nxt, path, quarantined = checkpoint.restore_latest(
+            small_engine(), tmp_path)
+    assert nxt == 1 and path.name == "ckpt_0"
+    assert len(quarantined) == 1
+
+    ck0 = tmp_path / "ckpt_0"
+    (ck0 / "opt.npz").write_bytes(b"also garbage")
+    with pytest.warns(UserWarning, match="quarantined"):
+        nxt, path, quarantined = checkpoint.restore_latest(
+            small_engine(), tmp_path)
+    assert (nxt, path) == (0, None) and len(quarantined) == 1
+
+
+def test_legacy_checkpoint_without_manifest_still_restores(tmp_path):
+    eng = small_engine()
+    ck = checkpoint.save(tmp_path, eng, epoch=3)
+    (ck / "manifest.json").unlink()  # a pre-round-10 checkpoint
+    assert checkpoint.latest(tmp_path) == ck
+    assert checkpoint.restore(small_engine(), ck) == 4
+
+
+def test_prune_never_deletes_last_verified(tmp_path):
+    """Retention vs corruption: keep=2 would normally drop ckpt_1, but
+    when both newer checkpoints are corrupt it is the only restorable
+    state and must survive the rotation."""
+    eng = small_engine()
+    for e in (1, 2, 3):
+        checkpoint.save(tmp_path, eng, epoch=e)
+    for e in (2, 3):
+        p = tmp_path / f"ckpt_{e}" / "params.npz"
+        raw = bytearray(p.read_bytes())
+        raw[50] ^= 1
+        p.write_bytes(bytes(raw))
+    checkpoint.prune(tmp_path, keep=2)
+    names = {p.name for p in tmp_path.iterdir()}
+    assert {"ckpt_1", "ckpt_2", "ckpt_3"} <= names  # ckpt_1 survived
+    with pytest.warns(UserWarning, match="quarantined"):
+        assert checkpoint.latest(tmp_path).name == "ckpt_1"
+
+
+# ----------------------------------------------------- injected faults
+
+
+def test_enospc_fault_leaves_latest_untouched(tmp_path):
+    eng = small_engine()
+    checkpoint.save(tmp_path / "ck", eng, epoch=0)
+    chaos.configure(FaultPlan.parse("enospc@2"))
+    checkpoint.save(tmp_path / "ck", eng, epoch=1)  # save #1: clean
+    with pytest.raises(OSError, match="ENOSPC|space"):
+        checkpoint.save(tmp_path / "ck", eng, epoch=2)  # save #2 dies
+    assert checkpoint.latest(tmp_path / "ck").name == "ckpt_1"
+    checkpoint.save(tmp_path / "ck", eng, epoch=3)  # fired once only
+    assert checkpoint.latest(tmp_path / "ck").name == "ckpt_3"
+
+
+def test_corrupt_fault_is_caught_at_restore(tmp_path):
+    chaos.configure(FaultPlan.parse("corrupt@2"))
+    eng = small_engine()
+    checkpoint.save(tmp_path, eng, epoch=0)
+    checkpoint.save(tmp_path, eng, epoch=1)  # save #2: corrupted post-hoc
+    with pytest.warns(UserWarning, match="quarantined"):
+        got = checkpoint.latest(tmp_path)
+    assert got.name == "ckpt_0"
+    assert (tmp_path / "ckpt_1.corrupt").exists()
+
+
+# ------------------------------------------- save-atomicity torture test
+
+
+TORTURE_CHILD = textwrap.dedent(f"""
+    import sys
+    sys.path.insert(0, {str(ROOT)!r})
+    import numpy as np
+    from shallowspeed_tpu import chaos, checkpoint
+
+    ckpt_dir, state_dir, seed, use_async = sys.argv[1:5]
+
+    class Eng:  # minimal engine surface the save path needs
+        opt_state = {{"m": np.arange(64, dtype=np.float32)}}
+        def get_canonical_params(self):
+            return [{{"W": np.full((64, 64), 0.5, np.float32),
+                      "b": np.zeros(64, np.float32)}}]
+
+    chaos.configure(chaos.FaultPlan.parse(
+        "kill_in_save@2", seed=int(seed), state_dir=state_dir))
+    eng = Eng()
+    if use_async == "1":
+        saver = checkpoint.AsyncSaver()
+        for epoch in range(4):   # save #2 dies on the WRITER thread
+            saver.save(ckpt_dir, eng, epoch)
+        saver.close()
+    else:
+        for epoch in range(4):   # save #2 dies on the main thread
+            checkpoint.save(ckpt_dir, eng, epoch)
+""")
+
+
+@pytest.mark.parametrize("use_async", ["0", "1"])
+def test_torture_sigkill_inside_save_window(tmp_path, use_async):
+    """The save-atomicity acceptance: children SIGKILL themselves at
+    SEEDED offsets inside the save window (between npz writes, before
+    the rename, after it — sync and async paths both); whatever state
+    that leaves on disk, `latest()` must only ever return a
+    manifest-verified checkpoint, and a later save must recover."""
+    child = tmp_path / "child.py"
+    child.write_text(TORTURE_CHILD)
+    for seed in range(4):  # sweep the seeded kill offsets
+        ck = tmp_path / f"ck_{use_async}_{seed}"
+        r = subprocess.run(
+            [sys.executable, str(child), str(ck),
+             str(tmp_path / f"cs_{use_async}_{seed}"), str(seed),
+             use_async],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode != 0, (seed, r.stdout, r.stderr)  # was killed
+        got = checkpoint.latest(ck)
+        if got is not None:
+            checkpoint.verify(got)  # never an unverified survivor
+        # epoch 0's save completed before the fault armed on save #2
+        assert got is not None and got.name in ("ckpt_0", "ckpt_1"), got
+        # a respawned "child" (fresh process state, same marker dir)
+        # saves cleanly over the wreckage
+        checkpoint.save(ck, _torture_engine(), epoch=9)
+        assert checkpoint.latest(ck).name == "ckpt_9"
+
+
+def _torture_engine():
+    class Eng:
+        opt_state = {"m": np.arange(64, dtype=np.float32)}
+
+        def get_canonical_params(self):
+            return [{"W": np.full((64, 64), 0.5, np.float32),
+                     "b": np.zeros(64, np.float32)}]
+
+    return Eng()
+
+
+# ---------------------------------------------------- goodput MTTR/fault
+
+
+def test_goodput_reports_mttr_availability_and_faults(tmp_path):
+    from shallowspeed_tpu.telemetry.goodput import (format_report,
+                                                    run_goodput)
+
+    log = tmp_path / "m.jsonl"
+    recs = [{"event": "run_start", "start_step": 0, "wall": 100.0},
+            {"event": "step", "step": 0, "loss": 1.0,
+             "tokens_per_sec": 1.0, "wall": 101.0, "t": 1.0},
+            {"event": "fault", "kind": "kill", "fault_id": "kill@1",
+             "wall": 101.5},
+            {"event": "ledger", "kind": "restart_downtime",
+             "seconds": 2.0, "fail_class": "crash", "wall": 103.4},
+            {"event": "run_start", "start_step": 0, "wall": 103.5},
+            {"event": "step", "step": 0, "loss": 1.0,
+             "tokens_per_sec": 1.0, "wall": 104.5, "t": 1.0},
+            {"event": "step", "step": 4, "loss": 1.0,
+             "tokens_per_sec": 1.0, "wall": 105.5, "t": 2.0},
+            {"event": "ledger", "kind": "restart_downtime",
+             "seconds": 1.0, "fail_class": "hang", "wall": 120.0},
+            {"event": "ledger", "kind": "poison_step_abort", "step": 4,
+             "fail_class": "crash", "wall": 121.0}]
+    log.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    rep = run_goodput(log)
+    assert rep["mttr"]["crash"]["count"] == 1
+    assert rep["mttr"]["crash"]["mttr_s"] == pytest.approx(2.0)
+    assert rep["mttr"]["crash"]["poison_step_abort"] == 1
+    assert rep["mttr"]["hang"]["mttr_s"] == pytest.approx(1.0)
+    assert rep["faults"] == {"kill": 1}
+    assert rep["availability"] is not None and rep["availability"] < 1.0
+    text = format_report(rep)
+    assert "mttr[crash" in text and "injected faults" in text
+    assert "availability" in text
+
+    from shallowspeed_tpu.telemetry.schema import validate_file
+
+    assert validate_file(log) == []
+
+
+# -------------------------------------------------- e2e chaos canary
+
+
+LM_BASE = ["--platform", "cpu", "--seq-len", "32", "--d-model", "32",
+           "--n-layers", "1", "--batch-size", "4", "--steps", "14",
+           "--log-every", "2", "--prefetch", "0", "--save-every", "4"]
+
+
+def _final_loss(log_path, step):
+    recs = [json.loads(l) for l in Path(log_path).read_text().splitlines()]
+    steps = [r for r in recs if r.get("event") == "step"
+             and r["step"] == step]
+    assert steps, f"no step-{step} line in {log_path}"
+    return steps[-1]["loss"]
+
+
+def test_chaos_canary_supervised_run_matches_oracle(tmp_path):
+    """The fast deterministic chaos acceptance (tier-1): a supervised
+    train_lm run under kill@9 + corrupt@2 + stall@5 must (a) finish all
+    steps with the EXACT final loss of a fault-free oracle — replay
+    from the last verified checkpoint is trajectory-preserving because
+    data/dropout are step-seeded — (b) quarantine the corrupted
+    checkpoint rather than restore it, and (c) account the wall clock
+    with a per-class MTTR in the goodput report."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    oracle_log = tmp_path / "oracle.jsonl"
+    r = subprocess.run(
+        [sys.executable, "train_lm.py", *LM_BASE,
+         "--save-dir", str(tmp_path / "oracle_ck"),
+         "--log-file", str(oracle_log)],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    log = tmp_path / "chaos.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-m", "shallowspeed_tpu.elastic",
+         "--max-restarts", "4", "--backoff", "0.3",
+         "--term-grace", "3",
+         "--chaos", "kill@9,corrupt@2,stall@5:0.3",
+         "--chaos-state", str(tmp_path / "cs"), "--",
+         sys.executable, "train_lm.py", *LM_BASE,
+         "--save-dir", str(tmp_path / "ck"), "--auto-resume",
+         "--log-file", str(log)],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # (a) trajectory-preserving recovery: exact final-loss match
+    assert _final_loss(log, 13) == _final_loss(oracle_log, 13)
+    # (b) the corrupted checkpoint was quarantined, never restored
+    corrupt = [p.name for p in (tmp_path / "ck").iterdir()
+               if ".corrupt" in p.name]
+    assert corrupt, "corruption fault fired but nothing was quarantined"
+    resumed = [l for l in r.stdout.splitlines() if "resumed from" in l]
+    assert resumed and not any(".corrupt" in l for l in resumed)
+    # every fault in the plan fired exactly once, stamped schema-v5
+    from shallowspeed_tpu.telemetry.schema import validate_file
+
+    assert validate_file(log) == []
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    fault_kinds = sorted(r_["kind"] for r_ in recs
+                         if r_.get("event") == "fault")
+    assert fault_kinds == ["corrupt", "kill", "stall"]
+    # (c) goodput: the run decomposes with MTTR per class
+    from shallowspeed_tpu.telemetry.goodput import run_goodput
+
+    rep = run_goodput(log)
+    assert rep["counts"]["restarts"] >= 1
+    assert rep["mttr"].get("crash", {}).get("count", 0) >= 1
+    assert rep["faults"] == {"corrupt": 1, "kill": 1, "stall": 1}
+    assert rep["losses"].get("data_stall", 0) > 0  # the stall was named
+    assert rep["accounted_frac"] >= 0.95, rep
+
+
+# -------------------------------------- full acceptance suite (slow)
+
+
+@pytest.mark.slow
+def test_chaos_acceptance_multi_fault_plan(tmp_path):
+    """ISSUE-7 acceptance: under a seeded plan injecting kill-mid-save,
+    post-hoc corruption, a NaN storm, a data stall, and a heartbeat
+    freeze (hang), the supervised run completes training with the
+    fault-free oracle's final loss at the same step count, restores
+    only verified checkpoints, and `--goodput` attributes >= 95% of
+    wall clock with per-fault-class MTTR reported."""
+    base = ["--platform", "cpu", "--seq-len", "32", "--d-model", "32",
+            "--n-layers", "1", "--batch-size", "4", "--steps", "24",
+            "--log-every", "2", "--prefetch", "0", "--save-every", "4",
+            "--health", "monitor"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    oracle_log = tmp_path / "oracle.jsonl"
+    r = subprocess.run(
+        [sys.executable, "train_lm.py", *base,
+         "--save-dir", str(tmp_path / "oracle_ck"),
+         "--log-file", str(oracle_log)],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # stall@4 exercises ledger accounting; kill_in_save@3 dies inside
+    # save #3's write window; corrupt@4 poisons a later checkpoint
+    # post-hoc; nan@13 poisons a param leaf (numeric storm -> labeled
+    # exit -> restart); freeze@17 stops heartbeats and stall@19:45
+    # wedges the loader long enough for the supervisor's staleness
+    # clock to hang-kill the child (SIGTERM-first, so the ledger tail
+    # survives). The 30 s hang timeout must exceed worst-case jax
+    # child startup on a loaded host (a slow spawn must not be
+    # mistaken for a hang), and the stall must exceed the timeout.
+    log = tmp_path / "chaos.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-m", "shallowspeed_tpu.elastic",
+         "--max-restarts", "6", "--backoff", "0.3",
+         "--hang-timeout", "30", "--term-grace", "5",
+         "--chaos",
+         "stall@4:0.4,kill_in_save@3,corrupt@4,nan@13,freeze@17,"
+         "stall@19:45",
+         "--chaos-state", str(tmp_path / "cs"), "--",
+         sys.executable, "train_lm.py", *base,
+         "--save-dir", str(tmp_path / "ck"), "--auto-resume",
+         "--log-file", str(log)],
+        capture_output=True, text=True, cwd=ROOT, env=env, timeout=900)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+
+    # completes all steps at the oracle's exact trajectory
+    assert _final_loss(log, 23) == _final_loss(oracle_log, 23)
+    # zero unverified restores: every 'resumed from' target was the
+    # verified survivor, never the corrupted path the fault stamped
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    faults = [r_ for r_ in recs if r_.get("event") == "fault"]
+    corrupted = [r_["path"] for r_ in faults
+                 if r_["kind"] == "corrupt"]
+    # on failure, show what DID fire plus the supervisor log tail —
+    # a timing flake must name itself, not just count to zero
+    assert len(corrupted) == 1, (faults, r.stdout[-3000:])
+    corrupt_dir = str(Path(corrupted[0]).parent)
+    resumed = [l for l in r.stdout.splitlines() if "resumed from" in l]
+    assert resumed
+    assert not any(corrupt_dir + " " in l or l.endswith(corrupt_dir)
+                   for l in resumed), (corrupt_dir, resumed)
+    # every planned fault kind fired
+    fault_kinds = {r_["kind"] for r_ in faults}
+    assert fault_kinds == {"stall", "kill_in_save", "corrupt", "nan",
+                           "freeze"}, (faults, r.stdout[-3000:])
+    # the supervisor saw multiple failure classes; the ledger carries
+    # per-class MTTR and >= 95% of wall clock has a name
+    from shallowspeed_tpu.telemetry.goodput import run_goodput
+
+    rep = run_goodput(log)
+    assert rep["counts"]["restarts"] >= 3
+    assert "crash" in rep["mttr"] and "hang" in rep["mttr"], rep["mttr"]
+    assert rep["accounted_frac"] >= 0.95, rep
+    assert rep["availability"] is not None
+    from shallowspeed_tpu.telemetry.schema import validate_file
+
+    assert validate_file(log) == []

@@ -9,6 +9,7 @@ without a checkpoint and resumes with one — the property every restart
 relies on).
 """
 
+import json
 import subprocess
 import sys
 import textwrap
@@ -355,6 +356,155 @@ def test_supervisor_leaves_caller_owned_heartbeat_file(tmp_path):
                      log=lambda *_: None)
     assert sup.run() == 0
     assert hb.exists()
+
+
+# ------------------------------- failure-class supervision (round 10)
+
+
+def test_policy_per_class_backoff_is_independent():
+    """Each failure class doubles on its own stream: two crashes then a
+    hang — the hang starts from the base backoff, not the crash
+    stream's doubled value."""
+    p = RestartPolicy(max_restarts=10, backoff=1.0, backoff_max=64.0)
+    assert p.next_restart("crash") == 1.0
+    assert p.next_restart("crash") == 2.0
+    assert p.next_restart("hang") == 1.0   # own stream
+    assert p.next_restart("crash") == 4.0
+    assert p.next_restart("hang") == 2.0
+    p.record_run(1e9)  # healthy run resets every stream and the budget
+    assert p.next_restart("crash") == 1.0
+    assert p.next_restart("hang") == 1.0
+
+
+def test_policy_jitter_is_seeded_and_bounded():
+    delays = [RestartPolicy(max_restarts=4, backoff=2.0, jitter=0.5,
+                            seed=7) for _ in range(2)]
+    seq = [[pol.next_restart("crash") for _ in range(3)]
+           for pol in delays]
+    assert seq[0] == seq[1]  # same seed -> same jitter stream
+    for base, got in zip([2.0, 4.0, 8.0], seq[0]):
+        assert base <= got <= base * 1.5  # stretch, never shrink
+    other = RestartPolicy(max_restarts=4, backoff=2.0, jitter=0.5,
+                          seed=8)
+    assert [other.next_restart("crash")
+            for _ in range(3)] != seq[0]  # the seed matters
+
+
+def _ledger_stamps(path, kind):
+    return [json.loads(l) for l in Path(path).read_text().splitlines()
+            if json.loads(l).get("kind") == kind]
+
+
+def test_supervisor_stamps_fail_class_crash_and_corrupt(tmp_path):
+    """Exit-code classification rides the restart stamps: a generic
+    nonzero exit is 'crash'; EXIT_CORRUPT_CKPT is 'corrupt_ckpt'."""
+    from shallowspeed_tpu.elastic import EXIT_CORRUPT_CKPT
+
+    log = tmp_path / "m.jsonl"
+    log.write_text("")
+    for code, expect in ((3, "crash"),
+                        (EXIT_CORRUPT_CKPT, "corrupt_ckpt")):
+        marker = tmp_path / f"ran_{code}"
+        cmd = _script(tmp_path, f"""
+            from pathlib import Path
+            m = Path({str(marker)!r})
+            if m.exists():
+                raise SystemExit(0)
+            m.write_text('x')
+            raise SystemExit({code})
+        """)
+        sup = Supervisor(cmd,
+                         RestartPolicy(max_restarts=2, backoff=0.01),
+                         ledger_file=str(log), log=lambda *_: None)
+        assert sup.run() == 0
+    classes = [r["fail_class"] for r in
+               _ledger_stamps(log, "restart_downtime")]
+    assert classes == ["crash", "corrupt_ckpt"]
+
+
+def test_supervisor_numeric_class_via_dead_heartbeat(tmp_path):
+    """A beating-but-dead child (heartbeat status 'dead ...') is
+    killed and classed 'numeric'."""
+    log = tmp_path / "m.jsonl"
+    log.write_text("")
+    hb = tmp_path / "hb"
+    marker = tmp_path / "died_once"
+    cmd = _script(tmp_path, f"""
+        import time
+        from pathlib import Path
+        m = Path({str(marker)!r})
+        if m.exists():
+            raise SystemExit(0)
+        m.write_text('x')
+        Path({str(hb)!r}).write_text('dead nonfinite gradients')
+        time.sleep(60)   # still 'alive' — only the status says dead
+    """) + ["--heartbeat-file", str(hb)]
+    sup = Supervisor(cmd, RestartPolicy(max_restarts=2, backoff=0.01),
+                     hang_timeout=30.0, poll_interval=0.1,
+                     term_grace=2.0, ledger_file=str(log),
+                     log=lambda *_: None)
+    t0 = time.monotonic()
+    assert sup.run() == 0
+    assert time.monotonic() - t0 < 40  # killed on status, not timeout
+    stamps = _ledger_stamps(log, "restart_downtime")
+    assert [r["fail_class"] for r in stamps] == ["numeric"]
+
+
+def test_supervisor_poison_step_aborts_with_forensics(tmp_path):
+    """The same step failing twice in a row is a poison step: labeled
+    abort + forensic snapshot after TWO attempts, not a crash loop
+    that burns the whole budget."""
+    log = tmp_path / "m.jsonl"
+    attempts = tmp_path / "attempts"
+    cmd = _script(tmp_path, f"""
+        import json
+        from pathlib import Path
+        a = Path({str(attempts)!r})
+        n = int(a.read_text()) if a.exists() else 0
+        a.write_text(str(n + 1))
+        with open({str(log)!r}, 'a') as f:
+            f.write(json.dumps({{"event": "step", "step": 7,
+                                 "loss": 1.0, "tokens_per_sec": 1.0,
+                                 "t": 0.1}}) + chr(10))
+        raise SystemExit(9)   # always dies right after step 7
+    """)
+    sup = Supervisor(cmd, RestartPolicy(max_restarts=10, backoff=0.01),
+                     ledger_file=str(log), log=lambda *_: None)
+    assert sup.run() == 9
+    assert attempts.read_text() == "2"  # aborted at the second strike
+    aborts = _ledger_stamps(log, "poison_step_abort")
+    assert len(aborts) == 1 and aborts[0]["step"] == 7
+    snap = json.loads(
+        Path(f"{log}.poison_step_7.json").read_text())
+    assert snap["poison_step"] == 7 and snap["fail_class"] == "crash"
+    assert snap["metrics_tail"]
+
+
+def test_term_grace_lets_child_flush_before_sigkill(tmp_path):
+    """The satellite contract: a hang-kill sends SIGTERM first, and a
+    child whose handler flushes state gets `term_grace` to do it —
+    the goodput-ledger tail survives the kill."""
+    flushed = tmp_path / "flushed"
+    marker = tmp_path / "hung_once"
+    hb = tmp_path / "hb"
+    cmd = _script(tmp_path, f"""
+        import signal, sys, time
+        from pathlib import Path
+        m = Path({str(marker)!r})
+        if m.exists():
+            raise SystemExit(0)
+        m.write_text('x')
+        def flush(signum, frame):
+            Path({str(flushed)!r}).write_text('ledger tail')
+            sys.exit(143)
+        signal.signal(signal.SIGTERM, flush)
+        time.sleep(120)   # hung: never beats
+    """) + ["--heartbeat-file", str(hb)]
+    sup = Supervisor(cmd, RestartPolicy(max_restarts=2, backoff=0.01),
+                     hang_timeout=8.0, poll_interval=0.2,
+                     term_grace=10.0, log=lambda *_: None)
+    assert sup.run() == 0
+    assert flushed.read_text() == "ledger tail"
 
 
 def test_gang_supervisor_cleans_up_heartbeat_files(tmp_path):

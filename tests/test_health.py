@@ -550,9 +550,10 @@ def test_elastic_kills_numerically_dead_child(tmp_path):
     sup = Supervisor([sys.executable, "-c", child],
                      hang_timeout=30.0, heartbeat_file=str(hb),
                      poll_interval=0.1)
-    code, secs = sup._run_once()
+    code, secs, fail_class = sup._run_once()
     assert code == -9
     assert secs < 20  # killed on the verdict, not the hang timeout
+    assert fail_class == "numeric"  # round 10: classed for MTTR
 
 
 def test_elastic_dead_kill_works_without_hang_timeout(tmp_path):
@@ -571,8 +572,9 @@ def test_elastic_dead_kill_works_without_hang_timeout(tmp_path):
     sup = Supervisor([sys.executable, "-c", child],
                      hang_timeout=None, heartbeat_file=str(hb),
                      poll_interval=0.1)
-    code, secs = sup._run_once()
+    code, secs, fail_class = sup._run_once()
     assert code == -9 and secs < 20
+    assert fail_class == "numeric"
 
 
 def test_elastic_restart_clears_stale_dead_status(tmp_path):
@@ -587,8 +589,9 @@ def test_elastic_restart_clears_stale_dead_status(tmp_path):
     sup = Supervisor([sys.executable, "-c", "import time; time.sleep(2)"],
                      hang_timeout=30.0, heartbeat_file=str(hb),
                      poll_interval=0.1)
-    code, secs = sup._run_once()
+    code, secs, fail_class = sup._run_once()
     assert code == 0, "fresh child was killed on the STALE dead status"
+    assert fail_class is None
 
 
 def test_heartbeat_status_roundtrip(tmp_path):

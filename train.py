@@ -18,6 +18,7 @@ Engines:
 from __future__ import annotations
 
 import argparse
+import sys
 import time
 from pathlib import Path
 
@@ -79,6 +80,20 @@ def parse_args(argv=None):
                    help="touch this file at every epoch log point — the "
                         "elastic supervisor's liveness signal "
                         "(shallowspeed_tpu/elastic.py hang detection)")
+    p.add_argument("--chaos", type=str, default="",
+                   help="deterministic fault injection (shallowspeed_"
+                        "tpu.chaos). On this driver kill/nan/freeze "
+                        "faults fire per EPOCH, stall@N fires at "
+                        "dataset BATCH id N (the Dataset.load_batch "
+                        "hook — batch ids restart each epoch, so it "
+                        "lands in the first epoch that loads batch N), "
+                        "and save faults count checkpoint saves; "
+                        "falls back to the supervisor-exported "
+                        "SHALLOWSPEED_CHAOS env")
+    p.add_argument("--chaos-state", type=str, default="",
+                   help="fired-fault marker dir (default: "
+                        "<save-dir>/.chaos); must survive restarts")
+    p.add_argument("--chaos-seed", type=int, default=0)
     p.add_argument("--log-file", type=str, default="",
                    help="append per-epoch JSONL metrics here")
     p.add_argument("--telemetry", default="off",
@@ -240,7 +255,9 @@ def train(args) -> float:
 
     import jax
 
-    from shallowspeed_tpu import checkpoint
+    from shallowspeed_tpu import chaos, checkpoint
+    from shallowspeed_tpu.elastic import (EXIT_CORRUPT_CKPT,
+                                          install_sigterm_exit)
     from shallowspeed_tpu.metrics import MetricsLogger
     from shallowspeed_tpu.parallel.schedules import (
         GPipeSchedule, NaiveParallelSchedule, PipeDreamSchedule)
@@ -252,6 +269,15 @@ def train(args) -> float:
         "pipedream": PipeDreamSchedule,
     }[args.schedule]
 
+    # supervisor kill path: exit through finally blocks on SIGTERM so
+    # the metrics tail flushes before the SIGKILL deadline
+    install_sigterm_exit()
+    chaos.setup(args.chaos, seed=args.chaos_seed,
+                state_dir=args.chaos_state
+                or (Path(args.save_dir) / ".chaos"
+                    if args.save_dir else None),
+                log_file=args.log_file or None)
+
     t_proc0 = time.time()  # goodput ledger: init = entry -> epoch loop
     engine, train_ds, val_ds = build(args)
     n_batches = train_ds[0].get_num_batches()
@@ -260,20 +286,32 @@ def train(args) -> float:
 
     start_epoch = 0
     if args.auto_resume and not args.resume:
-        # elastic restarts: resume iff a checkpoint exists, else fresh
+        # elastic restarts: resume iff a checkpoint EXISTS (cheap
+        # probe; restore_latest verifies, quarantines, falls back)
         if not args.save_dir:
             raise SystemExit("--auto-resume requires --save-dir")
-        if checkpoint.latest(args.save_dir) is not None:
+        if checkpoint.has_checkpoint(args.save_dir):
             args.resume = True
     if args.resume:
         if not args.save_dir:
             raise SystemExit("--resume requires --save-dir")
-        ck = checkpoint.latest(args.save_dir)
+        start_epoch, ck, quarantined = checkpoint.restore_latest(
+            engine, args.save_dir)
         if ck is None:
-            raise SystemExit(
-                f"--resume: no checkpoint found under {args.save_dir!r}")
-        start_epoch = checkpoint.restore(engine, ck)
-        rprint(f"resumed from {ck} at epoch {start_epoch}")
+            if args.auto_resume:
+                rprint(f"--auto-resume: no restorable checkpoint under "
+                       f"{args.save_dir!r}; starting fresh")
+            elif quarantined:
+                print(f"--resume: every checkpoint under "
+                      f"{args.save_dir!r} failed verification "
+                      f"({len(quarantined)} quarantined)",
+                      file=sys.stderr)
+                raise SystemExit(EXIT_CORRUPT_CKPT)
+            else:
+                raise SystemExit(f"--resume: no checkpoint found under "
+                                 f"{args.save_dir!r}")
+        else:
+            rprint(f"resumed from {ck} at epoch {start_epoch}")
 
     metrics = MetricsLogger(
         args.log_file, dp=args.dp, pp=args.pp, schedule=args.schedule,
@@ -325,12 +363,15 @@ def train(args) -> float:
     accuracy = 0.0
     with profile_ctx:
         for epoch in range(start_epoch, args.epochs):
+            # chaos step faults fire per EPOCH on this driver (its
+            # checkpoint cadence is the epoch)
+            chaos.on_step(epoch, engine)
             t_val = time.time()
             accuracy = compute_accuracy(engine, val_ds)
             ledger.note("val", seconds=time.time() - t_val)
             rprint(f"Epoch: {epoch}, Time Spent: {time.time() - start:.2f}s, "
                    f"Accuracy: {accuracy * 100:.2f}%")
-            if args.heartbeat_file:
+            if args.heartbeat_file and not chaos.heartbeat_frozen():
                 from shallowspeed_tpu.elastic import write_heartbeat
 
                 write_heartbeat(args.heartbeat_file,
@@ -394,7 +435,28 @@ def train(args) -> float:
                            f"hbm {tf.get('hbm_live_mib', 0):,.0f} MiB")
             if args.save_dir:
                 t_save = time.time()
-                checkpoint.save(args.save_dir, engine, epoch)
+                if monitor is not None and monitor.unhealthy():
+                    # never checkpoint a poisoned iterate (see
+                    # train_lm.py; found by the chaos NaN-storm drill)
+                    rprint(f"epoch {epoch}: health is "
+                           f"{monitor.heartbeat_status()!r} — "
+                           f"skipping checkpoint save")
+                    ledger.note("ckpt_save_skipped_unhealthy", count=1)
+                else:
+                    try:
+                        checkpoint.save(args.save_dir, engine, epoch)
+                    except (checkpoint.CheckpointError, OSError) as e:
+                        if jax.process_count() > 1:
+                            # peers already sit in the save barrier —
+                            # swallowing on process 0 would wedge the
+                            # gang; die and let the supervisor restart
+                            raise
+                        # atomic rename: latest() still points at the
+                        # previous checkpoint — keep training
+                        rprint(f"warning: checkpoint save failed "
+                               f"({e}); the previous checkpoint "
+                               f"remains the restore point")
+                        ledger.note("ckpt_save_failed", count=1)
                 ledger.note("ckpt_save", seconds=time.time() - t_save)
 
     accuracy = compute_accuracy(engine, val_ds)
@@ -407,6 +469,10 @@ def train(args) -> float:
             path = telem.write_summary(args.trace_dir)
             rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
 
+    plan = chaos.active()
+    if plan is not None and plan.unfired():
+        rprint(f"chaos: scheduled fault(s) never fired: "
+               f"{', '.join(plan.unfired())}")
     # Sanity check: DP replicas hold bit-identical weights (reference
     # `train.py:154-155`, `utils.py:27-31`).
     params = engine.params

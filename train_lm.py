@@ -250,11 +250,15 @@ def parse_args(argv=None):
                    help="write checkpoints on a background thread: the "
                         "device->host snapshot is synchronous (pins the "
                         "state), compression/IO never blocks training")
-    p.add_argument("--keep-checkpoints", type=int, default=0,
+    p.add_argument("--keep-checkpoints", "--keep-last", type=int,
+                   default=0, dest="keep_checkpoints",
                    help="checkpoint rotation: keep only the N newest "
                         "ckpt_* dirs (0 = keep all); a long elastic "
                         "run otherwise accumulates multi-GB "
-                        "checkpoints without bound")
+                        "checkpoints without bound. The newest "
+                        "VERIFIED checkpoint is never rotated away, "
+                        "whatever its age — if everything newer is "
+                        "corrupt, the one restorable state survives")
     p.add_argument("--save-every", type=int, default=100,
                    help="checkpoint every N steps when --save-dir is set")
     p.add_argument("--save-dir", type=str, default="")
@@ -300,6 +304,25 @@ def parse_args(argv=None):
                         "(streamed), trace.json (Chrome/Perfetto), "
                         "telemetry.json (run summary). Implies "
                         "--telemetry steps when the level is off")
+    p.add_argument("--chaos", type=str, default="",
+                   help="deterministic fault injection (shallowspeed_"
+                        "tpu.chaos): a seeded plan like "
+                        "'kill@9,corrupt@2,stall@5:0.5' (or a JSON "
+                        "path) scheduling faults at named injection "
+                        "points — process kill, SIGKILL inside the "
+                        "checkpoint write window, NaN-poisoned "
+                        "params, data-loader stall, heartbeat "
+                        "freeze, ENOSPC on save, post-hoc checkpoint "
+                        "corruption. Falls back to the supervisor-"
+                        "exported SHALLOWSPEED_CHAOS env. Each fault "
+                        "fires once and stamps a schema-v5 'fault' "
+                        "event into --log-file")
+    p.add_argument("--chaos-state", type=str, default="",
+                   help="fired-fault marker directory (default: "
+                        "<save-dir>/.chaos) — must survive restarts "
+                        "so a restarted child replays fault windows "
+                        "clean")
+    p.add_argument("--chaos-seed", type=int, default=0)
     p.add_argument("--val-every", type=int, default=0,
                    help="every N steps evaluate held-out loss/perplexity "
                         "(--text: last 10%% of the file; synthetic: a "
@@ -442,12 +465,27 @@ def train(args) -> float:
     distributed.initialize()
     from jax.sharding import Mesh
 
-    from shallowspeed_tpu import checkpoint
+    from shallowspeed_tpu import chaos, checkpoint
+    from shallowspeed_tpu.elastic import (EXIT_CORRUPT_CKPT,
+                                          install_sigterm_exit)
     from shallowspeed_tpu.metrics import MetricsLogger
     from shallowspeed_tpu.models.transformer import TransformerConfig
     from shallowspeed_tpu.optim import OPTIMIZERS
     from shallowspeed_tpu.parallel.context import ContextParallelEngine
     from shallowspeed_tpu.utils import rprint
+
+    # a supervisor hang/health kill sends SIGTERM first (--term-grace):
+    # exit through the finally blocks so the metrics/ledger tail the
+    # goodput reducer reads is flushed, not truncated mid-write
+    install_sigterm_exit()
+    # deterministic fault injection (--chaos flag or the supervisor-
+    # exported env); fired-fault markers default to living WITH the
+    # checkpoints so they survive supervisor restarts
+    chaos.setup(args.chaos, seed=args.chaos_seed,
+                state_dir=args.chaos_state
+                or (Path(args.save_dir) / ".chaos"
+                    if args.save_dir else None),
+                log_file=args.log_file or None)
 
     if ((args.resume or args.sample_only or args.auto_resume)
             and not args.save_dir):
@@ -718,19 +756,44 @@ def train(args) -> float:
     start_step = 0
     restored_ckpt = None
     if args.auto_resume and not args.resume:
-        # elastic restarts: resume iff a checkpoint exists, else fresh
-        if checkpoint.latest(args.save_dir) is not None:
+        # elastic restarts: resume iff a checkpoint EXISTS (cheap
+        # probe — restore_latest does the one verification pass,
+        # quarantining corrupt dirs and falling back), else fresh
+        if checkpoint.has_checkpoint(args.save_dir):
             args.resume = True
     restore_secs = 0.0
     if args.resume or args.sample_only:  # save-dir presence checked early
-        ck = checkpoint.latest(args.save_dir)
-        if ck is None:
-            raise SystemExit(f"--resume: no checkpoint under {args.save_dir!r}")
         t_restore = time.time()
-        start_step = checkpoint.restore(engine, ck)
-        restore_secs = time.time() - t_restore
-        restored_ckpt = ck
-        rprint(f"resumed from {ck} at step {start_step}")
+        start_step, restored_ckpt, quarantined = \
+            checkpoint.restore_latest(engine, args.save_dir)
+        if restored_ckpt is None:
+            if args.auto_resume and not args.sample_only:
+                # the restart-safe mode falls back to a fresh start —
+                # deterministic seeded data means the replayed
+                # trajectory is the same one the lost checkpoints held
+                rprint(f"--auto-resume: no restorable checkpoint under "
+                       f"{args.save_dir!r}"
+                       + (f" ({len(quarantined)} quarantined)"
+                          if quarantined else "") + "; starting fresh")
+                args.resume = False
+            elif quarantined:
+                # strict --resume with every checkpoint corrupt: a
+                # distinct exit code so the supervisor classes this as
+                # checkpoint corruption, not a generic crash
+                print(f"--resume: every checkpoint under "
+                      f"{args.save_dir!r} failed verification "
+                      f"({len(quarantined)} quarantined)",
+                      file=sys.stderr)
+                raise SystemExit(EXIT_CORRUPT_CKPT)
+            else:
+                raise SystemExit(
+                    f"--resume: no checkpoint under {args.save_dir!r}")
+        else:
+            restore_secs = time.time() - t_restore
+            if quarantined:
+                rprint(f"quarantined {len(quarantined)} corrupt "
+                       f"checkpoint(s); fell back to {restored_ckpt}")
+            rprint(f"resumed from {restored_ckpt} at step {start_step}")
 
     if not args.sample_only and start_step >= args.steps:
         raise SystemExit(
@@ -798,6 +861,23 @@ def train(args) -> float:
         else:
             checkpoint.save(ckpt_dir, engine, step, extra=extra,
                             keep=keep)
+
+    def _warn_save_failed(err):
+        # a failed save (ENOSPC, IO error) must not kill a healthy run:
+        # the atomic-rename contract means latest() still points at the
+        # previous checkpoint — keep training, name the loss in the
+        # ledger so --goodput shows the widened restart exposure.
+        # MULTI-PROCESS: swallowing is process-0-only state while the
+        # peers already sit in the save barrier — carrying on here
+        # would wedge the gang on the next mismatched collective, so
+        # re-raise and let the gang supervisor restart everyone (the
+        # async path's collective success-bit exchange is the
+        # equivalent contract).
+        if jax.process_count() > 1:
+            raise err
+        rprint(f"warning: checkpoint save failed ({err}); the previous "
+               f"checkpoint remains the restore point")
+        ledger.note("ckpt_save_failed", count=1)
 
     # ---- EMA of the weights: driver-owned, engine-agnostic (a pure
     # elementwise update on the engine's live params tree, whatever its
@@ -903,6 +983,11 @@ def train(args) -> float:
 
     def batches():
         for step in range(start_step, args.steps):
+            # chaos stall fault: injected HERE, in the producer, so a
+            # prefetched pipeline may absorb it (that's the overlap
+            # working) while --prefetch 0 must surface it as ledger
+            # data_stall seconds
+            chaos.on_data_load(step)
             tok, tgt = make_batch(args, vocab, step, text_data)
             # multi-host: every process builds the same seeded global batch
             # and feeds its own row-block (no-op single-process)
@@ -922,6 +1007,10 @@ def train(args) -> float:
         with profile_ctx:
             placed_it = iter(placed)
             for step in range(start_step, args.steps):
+                # chaos step faults: kill / param poison / heartbeat
+                # freeze, each at most once per plan (markers survive
+                # supervisor restarts, so the replay runs clean)
+                chaos.on_step(step, engine)
                 # input-pipeline stall accounting: with prefetch ahead
                 # this wait is ~0; a slow producer shows up as
                 # data_stall seconds in the goodput ledger
@@ -955,12 +1044,16 @@ def train(args) -> float:
                             raise SystemExit(
                                 f"health policy abort at step {step}: "
                                 + "; ".join(v.detail for v in fatal))
-                    if args.heartbeat_file:
+                    if args.heartbeat_file \
+                            and not chaos.heartbeat_frozen():
                         # liveness + health signal for the elastic
                         # supervisor: a stale mtime means a hung step
                         # loop; a 'dead ...' status means a numerically
                         # dead one (restart from the last good
-                        # checkpoint either way)
+                        # checkpoint either way). A chaos freeze fault
+                        # suppresses the beat — the run keeps stepping
+                        # and only the supervisor's staleness clock
+                        # can catch it (the hang drill).
                         from shallowspeed_tpu.elastic import (
                             write_heartbeat)
 
@@ -1132,7 +1225,38 @@ def train(args) -> float:
                     # models over the tunnel) must not depress the next
                     # window's rate — round-4 endurance lesson
                     ts = time.time()
-                    save_ckpt(args.save_dir, step)
+                    # never checkpoint a poisoned iterate: the restore
+                    # point must not BE the state the supervisor is
+                    # about to recover from (found by the chaos
+                    # NaN-storm drill). Two signals: the monitor's
+                    # last-observed pack, and THIS step's loss — a
+                    # poison landing between a log point and a save
+                    # would slip past the monitor alone. The float()
+                    # sync is free here: the save fetches the whole
+                    # state to host anyway.
+                    cur_loss = float(loss_dev)
+                    if (monitor is not None and monitor.unhealthy()) \
+                            or not np.isfinite(cur_loss):
+                        status = (monitor.heartbeat_status()
+                                  if monitor is not None
+                                  and monitor.unhealthy()
+                                  else f"loss {cur_loss}")
+                        rprint(f"step {step}: state is {status!r} — "
+                               f"skipping checkpoint save")
+                        ledger.note("ckpt_save_skipped_unhealthy",
+                                    count=1)
+                    else:
+                        try:
+                            save_ckpt(args.save_dir, step)
+                        except (checkpoint.CheckpointError,
+                                OSError) as e:
+                            _warn_save_failed(e)
+                        except RuntimeError as e:
+                            # the async saver surfaces its worker's
+                            # failure on the NEXT call, wrapped
+                            if "checkpoint" not in str(e):
+                                raise
+                            _warn_save_failed(e)
                     rates.pause(time.time() - ts, kind="ckpt_save")
             t_loop_done = time.time()
     finally:
@@ -1168,6 +1292,13 @@ def train(args) -> float:
                     print(f"[warn] async checkpoint save failed during "
                           f"teardown: {ckpt_err!r}", file=sys.stderr)
 
+    plan = chaos.active()
+    if plan is not None and plan.unfired():
+        # a clean exit with scheduled-but-unfired faults means the
+        # drill injected less than planned — say so, or a green run
+        # overstates what it proved
+        rprint(f"chaos: scheduled fault(s) never fired: "
+               f"{', '.join(plan.unfired())}")
     if args.generate > 0:
         t_sample = time.time()
         with ema_weights():
