@@ -1,0 +1,196 @@
+"""Decode-server driver: continuous batching over the paged KV cache.
+
+The serving counterpart of `train_lm.py` — builds a transformer LM
+(seeded init or random-weights demo; real deployments load a
+checkpoint via `--ckpt`), then serves a stream of requests through
+`shallowspeed_tpu.serving.ServingEngine`: requests join and leave the
+running decode batch between ticks (no recompiles after warmup), long
+prompts prefill in chunks interleaved with decode ticks, and every
+completion stamps a schema-v6 `"request"` SLO record (ttft/tpot/queue
+depth/preemptions) into the metrics JSONL that
+`python -m shallowspeed_tpu.telemetry --goodput` reduces to p50/p95.
+
+Requests arrive as JSONL (`--requests FILE`, `-` = stdin), one object
+per line:
+
+    {"id": "r0", "prompt": [17, 3, 92], "max_new": 24}
+    {"id": "r1", "prompt_len": 512, "prompt_seed": 7, "max_new": 16,
+     "temperature": 1.0, "seed": 5, "at": 0.25}
+
+`prompt` is explicit token ids; `prompt_len`(+`prompt_seed`) draws a
+random prompt — the tokenizer-free demo path. `at` is the submission
+offset in seconds from run start (default 0: submit immediately), so
+a request file doubles as an offered-load trace.
+
+Each completion prints one `{"event": "result", ...}` JSONL line to
+stdout; the run ends with the request-latency summary
+(`telemetry/report.request_summary`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    m = p.add_argument_group("model")
+    m.add_argument("--vocab", type=int, default=256)
+    m.add_argument("--d-model", type=int, default=64)
+    m.add_argument("--n-heads", type=int, default=4)
+    m.add_argument("--n-layers", type=int, default=2)
+    m.add_argument("--max-seq", type=int, default=512)
+    m.add_argument("--rope", action="store_true")
+    m.add_argument("--init-seed", type=int, default=0,
+                   help="weight-init seed for the demo model")
+    m.add_argument("--ckpt", default=None,
+                   help="checkpoint dir to load params from "
+                        "(shallowspeed_tpu.checkpoint layout)")
+    s = p.add_argument_group("serving")
+    s.add_argument("--n-blocks", type=int, default=128)
+    s.add_argument("--block-size", type=int, default=16)
+    s.add_argument("--slots", type=int, default=4,
+                   help="decode-slot capacity (the compiled tick's "
+                        "fixed row count)")
+    s.add_argument("--prefill-chunk", type=int, default=64)
+    s.add_argument("--table-bucket", type=int, default=4)
+    s.add_argument("--kv-quant", default="", choices=["", "int8"])
+    s.add_argument("--top-k", type=int, default=0)
+    s.add_argument("--top-p", type=float, default=0.0)
+    p.add_argument("--requests", default="-",
+                   help="JSONL request file, or - for stdin")
+    p.add_argument("--log-file", default=None,
+                   help="metrics JSONL (request/generate events)")
+    p.add_argument("--log-every", type=int, default=16,
+                   help="decode ticks between 'generate' stat lines")
+    p.add_argument("--platform", default=None,
+                   help="jax platform override (e.g. cpu)")
+    return p.parse_args(argv)
+
+
+def load_requests(path: str, vocab: int) -> list[dict]:
+    import numpy as np
+
+    raw = (sys.stdin.read() if path == "-"
+           else Path(path).read_text())
+    reqs = []
+    for i, line in enumerate(raw.splitlines()):
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        rec.setdefault("id", f"r{i}")
+        if "prompt" in rec:
+            # explicit token ids are the caller's exact prompt — an
+            # out-of-vocab id is an error, never a silent remap
+            rec["prompt"] = np.asarray(rec["prompt"], np.int32)
+            if rec["prompt"].size and (
+                    int(rec["prompt"].min()) < 0
+                    or int(rec["prompt"].max()) >= vocab):
+                raise ValueError(
+                    f"request {rec['id']!r}: prompt token ids must be "
+                    f"in [0, {vocab}); got range "
+                    f"[{int(rec['prompt'].min())}, "
+                    f"{int(rec['prompt'].max())}]")
+        else:
+            # the tokenizer-free demo path draws in-vocab ids directly
+            rng = np.random.default_rng(rec.get("prompt_seed", i))
+            rec["prompt"] = rng.integers(
+                0, vocab, rec["prompt_len"]).astype(np.int32)
+        rec.setdefault("at", 0.0)
+        reqs.append(rec)
+    reqs.sort(key=lambda r: r["at"])
+    return reqs
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    import jax
+    import numpy as np
+
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.serving import ServingEngine
+    from shallowspeed_tpu.telemetry.report import request_summary
+
+    cfg = T.TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, max_seq=args.max_seq, rope=args.rope)
+    if args.ckpt:
+        from shallowspeed_tpu import checkpoint
+
+        params = checkpoint.restore(args.ckpt)["params"]
+    else:
+        params = jax.device_put(T.init(cfg, seed=args.init_seed))
+    reqs = load_requests(args.requests, cfg.vocab)
+    metrics = MetricsLogger(
+        args.log_file, kind="serve", vocab=cfg.vocab,
+        d_model=cfg.d_model, n_layers=cfg.n_layers,
+        n_blocks=args.n_blocks, block_size=args.block_size,
+        slots=args.slots, prefill_chunk=args.prefill_chunk,
+        kv_quant=args.kv_quant)
+    eng = ServingEngine(
+        params, cfg, n_blocks=args.n_blocks,
+        block_size=args.block_size, max_slots=args.slots,
+        prefill_chunk=args.prefill_chunk,
+        table_bucket=args.table_bucket, kv_quant=args.kv_quant,
+        top_k=args.top_k, top_p=args.top_p, metrics=metrics,
+        log_every=args.log_every)
+
+    t0 = time.time()
+    i = 0
+    reported: set[str] = set()
+    while i < len(reqs) or eng.pending():
+        now = time.time() - t0
+        while i < len(reqs) and reqs[i]["at"] <= now:
+            r = reqs[i]
+            i += 1
+            try:
+                eng.submit(r["prompt"], r["max_new"],
+                           temperature=r.get("temperature", 0.0),
+                           seed=r.get("seed", 0), rid=r["id"])
+            except (KeyError, TypeError, ValueError) as e:
+                # one bad request (too long for max_seq/pool, duplicate
+                # id, missing/mistyped fields) must not kill the server
+                # — report it and keep draining the rest
+                print(json.dumps({"event": "error", "id": r["id"],
+                                  "error": f"{type(e).__name__}: {e}"}))
+        if eng.pending():
+            eng.step()
+        elif i < len(reqs):
+            time.sleep(min(0.05, max(0.0, reqs[i]["at"] - now)))
+        for rec in eng.request_records[len(reported):]:
+            reported.add(rec["id"])
+            print(json.dumps({
+                "event": "result", "id": rec["id"],
+                "tokens": [int(t) for t in eng.results[rec["id"]]],
+                "ttft_ms": rec["ttft_ms"],
+                "tpot_ms": rec.get("tpot_ms")}))
+    wall = time.time() - t0
+
+    summary = request_summary(eng.request_records) or {}
+    summary.update({
+        "wall_s": round(wall, 3),
+        "tok_per_sec": round(
+            sum(r["tokens_out"] for r in eng.request_records)
+            / max(wall, 1e-9), 2),
+        "ticks": eng.counters["ticks"],
+        "prefill_chunks": eng.counters["prefill_chunks"],
+        "preemptions": eng.counters["preempted"],
+        "executables": eng.executable_counts(),
+        "blocks_free_at_drain":
+            f"{eng.alloc.n_free}/{eng.alloc.n_usable}",
+    })
+    print(json.dumps({"event": "summary", **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

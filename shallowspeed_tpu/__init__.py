@@ -59,6 +59,7 @@ _EXPORTS = {
     "Supervisor": "shallowspeed_tpu.elastic",
     "RestartPolicy": "shallowspeed_tpu.elastic",
     # subsystem modules
+    "ServingEngine": "shallowspeed_tpu.serving",
     "analysis": "shallowspeed_tpu.analysis",
     "chaos": "shallowspeed_tpu.chaos",
     "checkpoint": "shallowspeed_tpu.checkpoint",
@@ -66,12 +67,14 @@ _EXPORTS = {
     "elastic": "shallowspeed_tpu.elastic",
     "metrics": "shallowspeed_tpu.metrics",
     "optim": "shallowspeed_tpu.optim",
+    "serving": "shallowspeed_tpu.serving",
     "telemetry": "shallowspeed_tpu.telemetry",
     "utils": "shallowspeed_tpu.utils",
 }
 
 _MODULE_EXPORTS = {"analysis", "chaos", "checkpoint", "distributed",
-                   "elastic", "metrics", "optim", "telemetry", "utils"}
+                   "elastic", "metrics", "optim", "serving", "telemetry",
+                   "utils"}
 
 __all__ = sorted(_EXPORTS) + ["functional"]
 

@@ -30,128 +30,17 @@ import jax
 import jax.numpy as jnp
 
 from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.models.kv_cache import (cache_write, cached_attention,
+                                              init_kv_cache, quantize_kv)
 
-
-def init_kv_cache(cfg: T.TransformerConfig, batch: int,
-                  cache_len: int | None = None, kv_quant: str = ""):
-    """Per-block K/V buffers (B, Hkv, cache_len, head_dim), zero-filled —
-    under GQA the cache holds the UNREPEATED kv heads, shrinking its
-    memory by the query-group factor.
-
-    HEAD-MAJOR layout (round 5): the decode sweep reads one head's
-    whole history per (batch, head) — with the old (B, S, Hkv, hd)
-    layout those reads were hd*2 = 128-byte rows at an Hkv*hd*2-byte
-    stride (sub-DMA-granularity: the b8 8k MHA sweep measured 257 GB/s
-    vs the 819 GB/s roofline); head-major makes each (b, h) sweep one
-    contiguous (S, hd) block. The per-token write transposes a
-    (B, 1, Hkv, hd) slice — noise next to the read it fixes.
-
-    `cache_len` defaults to cfg.max_seq; `generate` passes the SIZED
-    length (prompt bucket + max_new) instead — decode is HBM-bound on
-    the cache sweep, so a max_seq-sized buffer on a short generation
-    pays bandwidth for slots that can never be read (round-4 decode
-    hygiene, VERDICT r3).
-
-    `kv_quant="int8"` (round 5 — the batched-long-context lever the
-    round-4 roofline named): K/V store as int8 with one f32 scale per
-    (batch, position, head); the cache sweep's bytes halve vs bf16.
-    The scales ride OUTSIDE the attention einsums (K's scale multiplies
-    the score, V's folds into the probability row), so HBM reads stay
-    int8 — see `_cached_attention`."""
-    dt = cfg.compute_dtype or cfg.dtype
-    shape = (batch, cfg.kv_heads, cache_len or cfg.max_seq, cfg.head_dim)
-    if kv_quant:
-        assert kv_quant == "int8", kv_quant
-        sshape = shape[:3] + (1,)
-        return [{"k": jnp.zeros(shape, jnp.int8),
-                 "k_s": jnp.zeros(sshape, jnp.float32),
-                 "v": jnp.zeros(shape, jnp.int8),
-                 "v_s": jnp.zeros(sshape, jnp.float32)}
-                for _ in range(cfg.n_layers)]
-    return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
-            for _ in range(cfg.n_layers)]
-
-
-def _quantize_kv(x):
-    """(values int8, scales f32): symmetric per-(b, head, t) absmax
-    quantization over the head_dim axis (x: (B, Hkv, T, hd))."""
-    xf = x.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
-    scale = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
-    return q, scale
-
-
-def _cache_write(cache_blk, k, v, pos):
-    """Write this slice's K/V at `pos` (k/v arrive token-major
-    (B, T, Hkv, hd) from the block; the cache is head-major),
-    quantizing when the cache is int8 (the scale leaves' presence is
-    the dispatch)."""
-    k = jnp.swapaxes(k, 1, 2)
-    v = jnp.swapaxes(v, 1, 2)
-    if "k_s" in cache_blk:
-        kq, ks = _quantize_kv(k)
-        vq, vs = _quantize_kv(v)
-        upd = {"k": kq, "k_s": ks, "v": vq, "v_s": vs}
-    else:
-        upd = {"k": k.astype(cache_blk["k"].dtype),
-               "v": v.astype(cache_blk["v"].dtype)}
-    return {
-        **cache_blk,
-        **{name: jax.lax.dynamic_update_slice_in_dim(
-            cache_blk[name], val, pos, axis=2)
-           for name, val in upd.items()},
-    }
-
-
-def _cached_attention(q, cache_blk, pos, cfg):
-    """q: (B, 1, H, hd) at position `pos`; attends over cache[:, :pos+1].
-
-    The cache tail beyond `pos` is zeros — masked out by position, so its
-    contents never matter. GQA caches hold Hkv heads and are read
-    UNREPEATED (grouped einsum): decode is HBM-bandwidth-bound on the
-    cache sweep, so the group factor shrinks the per-step traffic, not
-    just the cache footprint.
-    """
-    k, v = cache_blk["k"], cache_blk["v"]       # (B, Hkv, S, hd)
-    b, _, h, hd = q.shape
-    kvh = k.shape[1]
-    slots = k.shape[2]
-    quant = "k_s" in cache_blk
-    qg = q.reshape(b, 1, kvh, h // kvh, hd)
-    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
-    if quant:
-        # int8 sweep: the einsum reads int8 rows (the cast fuses into
-        # the load; int8 values are EXACT in bf16, so the MXU runs at
-        # its bf16 rate with f32 accumulation); K's per-(b, head, t)
-        # scale is constant over hd, so it multiplies the SCORE
-        # instead of dequantizing the cache
-        cdt = cfg.compute_dtype or cfg.dtype
-        s = jnp.einsum("bqhgd,bhkd->bhgqk", qg.astype(cdt),
-                       k.astype(cdt),
-                       preferred_element_type=jnp.float32)
-        s = s * cache_blk["k_s"][..., 0][:, :, None, None, :]
-        s = s * scale
-    else:
-        s = jnp.einsum("bqhgd,bhkd->bhgqk", qg, k,
-                       preferred_element_type=jnp.float32) * scale
-    valid = jnp.arange(slots) <= pos                       # (S,)
-    if cfg.attn_window > 0:  # same window the training mask applies
-        valid = valid & (jnp.arange(slots) > pos - cfg.attn_window)
-    s = jnp.where(valid[None, None, None, None, :], s, jnp.float32(-1e30))
-    p = jax.nn.softmax(s, axis=-1)
-    if quant:
-        # V's scale varies along the summation index — fold it into the
-        # (tiny) probability rows, keeping the V read int8
-        cdt = cfg.compute_dtype or cfg.dtype
-        pv = p * cache_blk["v_s"][..., 0][:, :, None, None, :]
-        out = jnp.einsum("bhgqk,bhkd->bqhgd", pv.astype(cdt),
-                         v.astype(cdt),
-                         preferred_element_type=jnp.float32)
-    else:
-        out = jnp.einsum("bhgqk,bhkd->bqhgd", p.astype(v.dtype), v,
-                         preferred_element_type=jnp.float32)
-    return out.reshape(b, 1, h, hd).astype(q.dtype)
+# Round-11 refactor: the cache primitives moved to `models/kv_cache.py`
+# so the serving runtime (`shallowspeed_tpu/serving/` — paged block
+# pools) shares the exact write/quantize/attend math with this
+# contiguous path. Old private names kept as aliases — the ops are
+# UNCHANGED, so every pinned stream stays bit-identical.
+_quantize_kv = quantize_kv
+_cache_write = cache_write
+_cached_attention = cached_attention
 
 
 def _block_decode(p, x, cfg: T.TransformerConfig, cache_blk, pos):
@@ -248,13 +137,12 @@ def decode_step(params, token, pos, cache, cfg: T.TransformerConfig):
     return logits.astype(jnp.float32), new_cache
 
 
-def _sample(logits, rng, temperature: float, top_k: int,
-            top_p: float = 0.0):
-    """logits (B, V) f32 -> token ids (B,). temperature 0 = greedy;
-    top_k and top_p (nucleus) filters compose (k first, then p)."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+def filter_logits(logits, top_k: int, top_p: float):
+    """Row-wise top-k then nucleus (top-p) support truncation on
+    temperature-scaled logits (B, V). Shared by `_sample` and the
+    serving engine's per-row sampler — ONE implementation, so the
+    pinned serving-vs-`generate()` stream parity cannot drift on
+    filtered runs."""
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]       # (B, 1)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -269,6 +157,16 @@ def _sample(logits, rng, temperature: float, top_k: int,
         keep = jnp.zeros_like(keep_sorted).at[
             jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
         logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
+
+
+def _sample(logits, rng, temperature: float, top_k: int,
+            top_p: float = 0.0):
+    """logits (B, V) f32 -> token ids (B,). temperature 0 = greedy;
+    top_k and top_p (nucleus) filters compose (k first, then p)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = filter_logits(logits / temperature, top_k, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -336,7 +234,12 @@ def decode_report(params, cfg: T.TransformerConfig, batch: int,
     convention."""
     from shallowspeed_tpu.flops import device_mem_bandwidth
 
-    assert seconds > 0 and n_tokens > 0
+    if seconds <= 0 or n_tokens <= 0:
+        # typed, not an assert (asserts vanish under python -O and this
+        # guards a division on a production progress line)
+        raise ValueError(f"decode_report needs seconds > 0 and "
+                         f"n_tokens > 0, got seconds={seconds!r}, "
+                         f"n_tokens={n_tokens!r}")
     steps_per_sec = n_tokens / seconds          # decode steps (all rows)
     bpt = (decode_read_bytes_per_token(params, cfg, batch, cache_len,
                                        kv_quant)
@@ -453,13 +356,30 @@ def generate(params, prompt, cfg: T.TransformerConfig, max_new: int,
     `kv_quant="int8"` (round 5): quantized KV cache — halves the
     cache-sweep bytes for batched long-context decode at a small
     numerics cost (per-head absmax scales; logits move at the ~1e-2
-    level, so streams are NOT bit-identical to the bf16 cache)."""
+    level, so streams are NOT bit-identical to the bf16 cache). (3)
+    PAGED decode (round 11, `shallowspeed_tpu/serving/`): the serving
+    engine reads the same cache math through a gathered block table
+    (`models/kv_cache.masked_attention` is the shared core) with the
+    same per-request sampling keys (`fold_in(PRNGKey(seed),
+    token_index)`) — but its table width is bucketed in BLOCKS, not
+    this path's 64-token prompt bucket, so the softmax reduction
+    shape differs and paged logits match this path to ~1e-6 (pinned
+    <= 1e-4), NOT bit-exactly. In practice sampled streams coincide
+    (tests/test_serving.py pins solo-request streams token-for-token
+    against this function, greedy and sampled); callers needing a
+    guaranteed-bit-stable stream must stay on ONE of the two paths."""
     b, tp = prompt.shape
     assert tp + max_new <= cfg.max_seq, (
         f"prompt {tp} + max_new {max_new} exceeds max_seq={cfg.max_seq}")
+    # jnp.asarray on BOTH branches (round 11): the no-padding branch
+    # used to hand the caller's raw array straight to jit while the
+    # padded branch converted — dtype/device normalization differed by
+    # prompt LENGTH (e.g. int64 host arrays weak-typing differently),
+    # a shape-dependent input regime
+    prompt = jnp.asarray(prompt)
     tp_b = prompt_bucket_len(tp, max_new, cfg.max_seq)
     if tp_b != tp:
-        prompt = jnp.pad(jnp.asarray(prompt), ((0, 0), (0, tp_b - tp)))
+        prompt = jnp.pad(prompt, ((0, 0), (0, tp_b - tp)))
     return _generate_padded(params, prompt, jnp.int32(tp), cfg, max_new,
                             temperature, top_k, top_p, seed,
                             cache_len=tp_b + max_new,

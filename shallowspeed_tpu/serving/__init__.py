@@ -1,0 +1,21 @@
+"""Serving runtime: paged KV cache + continuous-batching decode server.
+
+ROADMAP item 1 (round 11). `cache.py` owns the memory model (block
+pools, the free-list `BlockAllocator`, gathered-table reads, the
+live-blocks HBM byte model); `engine.py` owns the compiled decode
+tick / chunked prefill and the scheduler (admission, preemption,
+per-request SLO telemetry). `serve.py` at the repo root is the CLI
+driver; `tests/test_serving.py` pins stream parity against
+`generate()` and the zero-recompile churn contract.
+"""
+
+from shallowspeed_tpu.serving.cache import (BlockAllocator,  # noqa: F401
+                                            OutOfBlocks, blocks_for,
+                                            init_block_pool,
+                                            paged_read_bytes_per_tick)
+from shallowspeed_tpu.serving.engine import (ServingEngine,  # noqa: F401
+                                             table_width)
+
+__all__ = ["BlockAllocator", "OutOfBlocks", "ServingEngine",
+           "blocks_for", "init_block_pool", "paged_read_bytes_per_tick",
+           "table_width"]

@@ -1,0 +1,216 @@
+"""Paged KV cache: block pools, a host-side free-list allocator, and
+the gathered-table read path.
+
+The contiguous decode cache (`models/kv_cache.init_kv_cache`) sizes one
+(B, Hkv, slots, hd) buffer per request batch — fine for one `generate()`
+call, useless for a server where requests of different lengths join and
+leave continuously: every admission would recompile, and every short
+request would pay the longest request's slots. The paged layout instead
+carves each layer's cache into fixed `(n_blocks, Hkv, block_size, hd)`
+POOLS (vLLM's PagedAttention memory model, arXiv 2309.06180, rebuilt
+jit-first): a request owns an ordered list of block ids (its *block
+table*), the pools are donated through every compiled tick (no copies,
+stable buffers), and attention reads through a GATHERED view of the
+table — `pool[bt]` — masked by position. Appending a token allocates at
+most one block; freeing a finished request returns its blocks in O(1);
+fragmentation cannot exist because any free block serves any request.
+
+Block 0 is RESERVED as a scratch sink: compiled programs run at a fixed
+slot capacity, so inactive slots (and the padded tail of a prefill
+chunk) still execute their cache write — they are steered to block 0,
+which no live table ever contains. That keeps the tick free of
+host-side branching without ever corrupting a live block.
+
+int8 pools mirror the contiguous int8 cache exactly (same per-(row,
+head, position) absmax scales via `kv_cache.quantize_kv`), so the paged
+sweep halves its bytes the same way.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.models.kv_cache import KV_QUANT_MODES, quantize_kv
+
+SCRATCH_BLOCK = 0
+
+
+class OutOfBlocks(RuntimeError):
+    """The free list is empty. The scheduler's preemption policy (evict
+    the newest running request, re-queue it with its blocks freed)
+    catches this; it never escapes a `ServingEngine.step`."""
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold `n_tokens` cache positions."""
+    return max(0, -(-int(n_tokens) // int(block_size)))
+
+
+def init_block_pool(cfg: T.TransformerConfig, n_blocks: int,
+                    block_size: int, kv_quant: str = ""):
+    """Per-layer paged K/V pools (n_blocks, Hkv, block_size, hd),
+    zero-filled; int8 pools add the (n_blocks, Hkv, block_size, 1) f32
+    scale planes, matching `init_kv_cache`'s int8 variant per-position.
+    Layout is the contiguous cache's head-major sweep with the slot
+    axis folded into (block id, offset)."""
+    if kv_quant not in KV_QUANT_MODES:
+        raise ValueError(
+            f"unsupported kv_quant={kv_quant!r}; expected one of "
+            f"{KV_QUANT_MODES} ('' = pool in the compute dtype)")
+    if n_blocks < 2:
+        raise ValueError(f"n_blocks={n_blocks} leaves no usable blocks "
+                         f"past the reserved scratch block")
+    dt = cfg.compute_dtype or cfg.dtype
+    shape = (n_blocks, cfg.kv_heads, block_size, cfg.head_dim)
+    if kv_quant:
+        sshape = shape[:3] + (1,)
+        return [{"k": jnp.zeros(shape, jnp.int8),
+                 "k_s": jnp.zeros(sshape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "v_s": jnp.zeros(sshape, jnp.float32)}
+                for _ in range(cfg.n_layers)]
+    return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            for _ in range(cfg.n_layers)]
+
+
+class BlockAllocator:
+    """Host-side free list over one pool's block ids.
+
+    Pure bookkeeping — no device arrays. Invariants (pinned in
+    tests/test_serving.py): a block is owned by at most one holder;
+    `free` rejects ids not currently allocated; at drain
+    `n_free == n_usable` (alloc and free balance); block 0 (scratch)
+    is never handed out."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(f"n_blocks={n_blocks} leaves no usable "
+                             f"blocks past the reserved scratch block")
+        self.n_blocks = int(n_blocks)
+        # LIFO free list: recently freed (still-warm) blocks are reused
+        # first; ids 1..n-1 — block 0 is the scratch sink
+        self._free = list(range(self.n_blocks - 1, 0, -1))
+        self._allocated: set[int] = set()
+
+    @property
+    def n_usable(self) -> int:
+        return self.n_blocks - 1
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_allocated(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> list[int]:
+        """Pop `n` blocks off the free list, or raise OutOfBlocks
+        WITHOUT partial allocation (all-or-nothing, so a failed
+        admission never leaks)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise OutOfBlocks(f"need {n} blocks, {len(self._free)} free")
+        ids = [self._free.pop() for _ in range(n)]
+        self._allocated.update(ids)
+        return ids
+
+    def free(self, ids) -> None:
+        ids = list(ids)
+        bad = [i for i in ids if i not in self._allocated]
+        if bad:
+            raise ValueError(f"free() of unallocated block(s) {bad}")
+        for i in ids:
+            self._allocated.discard(i)
+            self._free.append(i)
+
+
+def gather_table(pool_blk, bt):
+    """Read one layer's cache through a block table.
+
+    pool_blk: {"k"/"v": (N, Hkv, bs, hd)[, "k_s"/"v_s": (N, Hkv, bs, 1)]}
+    bt: (rows, W) int32 block ids (padding rows/tail point at the
+    scratch block — the caller's position mask never admits them).
+    Returns the contiguous-cache view {"k"/"v": (rows, Hkv, W*bs, hd),
+    ...} that `kv_cache.masked_attention` consumes: gathered position
+    j IS absolute position j because tables are ordered."""
+    rows, w = bt.shape
+    out = {}
+    for name, leaf in pool_blk.items():
+        n, hkv, bs, tail = leaf.shape
+        g = leaf[bt]                           # (rows, W, Hkv, bs, tail)
+        out[name] = jnp.swapaxes(g, 1, 2).reshape(rows, hkv, w * bs,
+                                                  tail)
+    return out
+
+
+def write_rows(pool_blk, k_rows, v_rows, blk_ids, offs, quant: bool):
+    """Scatter per-row single-token K/V into one layer's pools.
+
+    k_rows/v_rows: (rows, Hkv, hd) in compute dtype; blk_ids/offs:
+    (rows,) int32 destination (block id, in-block offset). Rows steered
+    to the scratch block may collide — by construction nothing ever
+    reads scratch, so the unspecified duplicate-scatter winner is
+    irrelevant. Quantization matches `kv_cache.cache_write`'s int8
+    path value-for-value (same absmax-over-hd scales)."""
+    if quant:
+        kq, ks = quantize_kv(k_rows[:, :, None, :])   # (rows,Hkv,1,hd)
+        vq, vs = quantize_kv(v_rows[:, :, None, :])
+        upd = {"k": kq[:, :, 0], "k_s": ks[:, :, 0],
+               "v": vq[:, :, 0], "v_s": vs[:, :, 0]}
+    else:
+        upd = {"k": k_rows.astype(pool_blk["k"].dtype),
+               "v": v_rows.astype(pool_blk["v"].dtype)}
+    return {name: pool_blk[name].at[blk_ids, :, offs, :].set(val)
+            for name, val in upd.items()}
+
+
+# ------------------------------------------------ per-tick HBM model
+#
+# `models/generate.decode_read_bytes_per_token` prices one contiguous
+# decode step: params + the FULL cache sweep. The paged tick's useful
+# sweep is only the LIVE blocks its requests touch — the number below
+# is the per-tick generalization the serving progress lines report
+# (the gathered table also reads its bucket-padding blocks; that
+# padding is the bucketing tax, reported separately as the ratio).
+
+
+def param_read_bytes(params, cfg: T.TransformerConfig) -> int:
+    """Bytes one decode pass reads for the parameters alone, at the
+    dtype decode actually consumes after `cast_params` (eval_shape —
+    no on-device copy). Constant for an engine's lifetime: callers on
+    a hot path compute it once and pass it back in."""
+    import jax
+
+    from shallowspeed_tpu.analysis.walker import aval_bytes
+
+    cast = jax.eval_shape(lambda p: T.cast_params(p, cfg.compute_dtype),
+                          params)
+    return int(sum(aval_bytes(l) for l in
+                   jax.tree_util.tree_leaves(cast)))
+
+
+def paged_read_bytes_per_tick(params, cfg: T.TransformerConfig,
+                              blocks_touched: int, block_size: int,
+                              n_rows: int, kv_quant: str = "",
+                              p_bytes: int | None = None) -> int:
+    """HBM READ bytes one decode tick usefully moves: every param leaf
+    (at the decode compute dtype) + the K/V bytes of the live blocks
+    the tick's active requests attend over (+ int8 scale planes) + the
+    token ids. `blocks_touched` = sum over active rows of
+    blocks_for(context_len) — the live-blocks generalization of the
+    contiguous model's full-cache sweep. Pass a precomputed `p_bytes`
+    (`param_read_bytes`) on hot paths — the param term never changes."""
+    import numpy as np
+
+    if p_bytes is None:
+        p_bytes = param_read_bytes(params, cfg)
+    kv_itemsize = (1 if kv_quant == "int8"
+                   else np.dtype(cfg.compute_dtype or cfg.dtype).itemsize)
+    per_block = 2 * cfg.kv_heads * block_size * cfg.head_dim * kv_itemsize
+    if kv_quant == "int8":
+        per_block += 2 * cfg.kv_heads * block_size * 4   # f32 scales
+    return (p_bytes + cfg.n_layers * int(blocks_touched) * per_block
+            + n_rows * 4)

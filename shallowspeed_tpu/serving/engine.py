@@ -1,0 +1,561 @@
+"""Continuous-batching decode server over the paged KV cache.
+
+`generate()` serves ONE batch synchronously: every row prefills
+together, decodes together, and finishes together — concurrent
+requests of different lengths either recompile per shape or block
+head-of-line behind the longest. This engine serves a STREAM:
+
+- **Fixed-capacity decode slots.** One compiled decode tick advances
+  every running request by one token. The tick's row count is pinned
+  to `max_slots` and its gathered block-table width is bucketed
+  GEOMETRICALLY in blocks, so requests join and leave the running
+  batch between ticks with NO recompiles after warmup — one executable
+  per (width bucket), pinned like `test_vm_executables_compile_exactly
+  _once`. Empty slots still execute (their cache writes are steered to
+  the reserved scratch block) — occupancy is DATA, not shape.
+- **Chunked prefill.** Prompts prefill `prefill_chunk` tokens per
+  engine step, interleaved with decode ticks — an 8k prompt admitted
+  mid-run delays in-flight decodes by at most one chunk per tick
+  instead of one full prefill. Chunks are padded to the fixed chunk
+  length with the true length traced (the `prompt_bucket_len` idea at
+  chunk granularity), pad positions write to scratch.
+- **Admission + preemption.** A request is admitted when a slot and
+  its prompt's blocks are free; a decode append that finds the pool
+  empty EVICTS the newest-admitted running request (its blocks free
+  immediately, it re-queues at the front and later re-prefills its
+  prompt + already-generated tokens, continuing its stream where it
+  left off). Evicting the NEWEST — the request that has waited least
+  — is what makes the policy livelock-free: the oldest running
+  request always progresses, and `submit` rejects requests that
+  could never fit alone, so the allocator cannot deadlock.
+- **Per-request SLO telemetry.** Every completion stamps a schema-v6
+  `"request"` event (ttft_ms, tpot_ms, queue depth, preemptions,
+  tokens in/out) into the run's metrics JSONL; periodic `"generate"`
+  lines carry tick throughput and the live-blocks HBM sweep
+  (`cache.paged_read_bytes_per_tick` — the serving generalization of
+  `decode_read_bytes_per_token`).
+
+Stream parity: sampling uses the SAME per-request key schedule as
+`generate()` — token i of a request with sampling seed s draws from
+`fold_in(PRNGKey(s), i)` — and the paged attention shares
+`kv_cache.masked_attention` with the contiguous path, so each
+request's stream reproduces its solo `generate()` stream
+token-for-token (pinned in tests/test_serving.py; see `generate`'s
+stream-stability contract for the ~1e-6 numerics caveat).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shallowspeed_tpu.models import generate as G
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.models.kv_cache import masked_attention
+from shallowspeed_tpu.serving.cache import (SCRATCH_BLOCK, BlockAllocator,
+                                            OutOfBlocks, blocks_for,
+                                            gather_table, init_block_pool,
+                                            paged_read_bytes_per_tick,
+                                            param_read_bytes, write_rows)
+
+
+def table_width(n_blocks: int, base: int) -> int:
+    """Geometric block-table width bucket (base, 2*base, 4*base, ...):
+    the compile key for the gathered reads. Linear bucketing would
+    compile O(prompt/bucket) executables as a long prompt's table
+    grows; geometric pins the executable count at O(log) — the
+    serving analog of `prompt_bucket_len`."""
+    w = max(1, int(base))
+    n = max(1, int(n_blocks))
+    while w < n:
+        w *= 2
+    return w
+
+
+def _rope_rows(x, pos, theta: float):
+    """`T.rope_rotate` with a PER-ROW position: x (S, 1, H, D), pos
+    (S,) — each slot decodes at its own global position. Swapping the
+    row axis into rope_rotate's sequence axis reuses the ONE rotary
+    implementation (same half-split math and f32 phases), so a row's
+    values equal the contiguous path's at the same position by
+    construction."""
+    return jnp.swapaxes(
+        T.rope_rotate(jnp.swapaxes(x, 0, 1), pos, theta), 0, 1)
+
+
+def _sample_rows(logits, temp, seeds, idx, top_k: int, top_p: float):
+    """Row-wise `generate._sample`: per-row temperature and sampling
+    key (`fold_in(PRNGKey(seed), idx)` — `idx` is the request's token
+    index, so a slot's draws equal its solo `generate()` draws).
+    temp == 0 rows take the greedy argmax; top_k/top_p are engine-wide
+    statics (lax.top_k needs a static k)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    l = G.filter_logits(logits / jnp.maximum(temp, 1e-6)[:, None],
+                        top_k, top_p)
+
+    def draw(seed, i, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+        return jax.random.categorical(key, row, axis=-1)
+
+    sampled = jax.vmap(draw)(seeds, idx, l).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
+_sample_jit = jax.jit(_sample_rows, static_argnames=("top_k", "top_p"))
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_k", "top_p"),
+         donate_argnums=(1,))
+def _decode_tick(params, pools, tok, pos, bt, temp, seeds, idx, *,
+                 cfg: T.TransformerConfig, top_k: int, top_p: float):
+    """One compiled decode tick over the whole slot batch.
+
+    tok/pos/temp/seeds/idx: (S,) per-slot last token, write position,
+    sampling state; bt: (S, W) block tables (W is the bucketed width —
+    the ONLY shape that varies across ticks). Each slot writes its
+    token's K/V at (bt[pos // bs], pos % bs) and attends over its
+    gathered table under the position mask; inactive slots carry
+    pos=0 / bt=scratch and their results are ignored host-side.
+    Returns (next token per slot, updated pools); pools are DONATED —
+    the caches update in place across ticks."""
+    params = T.cast_params(params, cfg.compute_dtype)
+    s_rows = tok.shape[0]
+    bs = pools[0]["k"].shape[2]
+    w = bt.shape[1]
+    quant = "k_s" in pools[0]
+    x = params["tok_emb"][tok][:, None, :]                  # (S, 1, d)
+    if not cfg.rope:
+        x = x + params["pos_emb"][pos][:, None, :]
+    if cfg.compute_dtype is not None:
+        x = x.astype(cfg.compute_dtype)
+    rows = jnp.arange(s_rows)
+    blk = bt[rows, pos // bs]
+    off = pos % bs
+    span = jnp.arange(w * bs)
+    valid = span[None, :] <= pos[:, None]                   # (S, W*bs)
+    if cfg.attn_window > 0:
+        valid = valid & (span[None, :] > pos[:, None] - cfg.attn_window)
+    valid = valid[:, None, None, None, :]
+    new_pools = []
+    for p, pool in zip(params["blocks"], pools):
+        h = T._norm(p["ln1"], x, cfg)
+        q, k, v = T._qkv(p, h, cfg)
+        if cfg.rope:
+            q = _rope_rows(q, pos, cfg.rope_theta)
+            k = _rope_rows(k, pos, cfg.rope_theta)
+        pool = {**pool, **write_rows(pool, k[:, 0], v[:, 0], blk, off,
+                                     quant)}
+        a = masked_attention(q, gather_table(pool, bt), valid, cfg)
+        x = x + T._dense(p["proj"], a.reshape(s_rows, 1, cfg.d_model))
+        h = T._norm(p["ln2"], x, cfg)
+        x, _aux = T._ffn(p, x, cfg, h)
+        new_pools.append(pool)
+    x = T._norm(params["ln_f"], x, cfg)
+    logits = T.head_logits(params, x[:, 0], cfg).astype(jnp.float32)
+    nxt = _sample_rows(logits, temp, seeds, idx, top_k, top_p)
+    return nxt, new_pools
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _prefill_chunk(params, pools, tokens, pos0, n_tok, bt, *,
+                   cfg: T.TransformerConfig):
+    """One chunk of a request's prefill: tokens (1, C) — C is the
+    fixed chunk length, `n_tok` the traced true count (the tail is
+    padding, steered to the scratch block exactly like `generate`'s
+    bucket padding is overwritten-before-read). Writes the chunk's
+    K/V through the block table and attends causally over the table
+    (earlier chunks included). Returns (f32 logits at the chunk's last
+    true position — consumed only on the final chunk — and the
+    updated, donated pools)."""
+    params = T.cast_params(params, cfg.compute_dtype)
+    c = tokens.shape[1]
+    bs = pools[0]["k"].shape[2]
+    w = bt.shape[1]
+    quant = "k_s" in pools[0]
+    pos = pos0 + jnp.arange(c)
+    x = G._embed(params, tokens, pos0, cfg)                  # (1, C, d)
+    j = jnp.arange(c)
+    keep = j < n_tok
+    blk = jnp.where(keep, bt[0, jnp.clip(pos // bs, 0, w - 1)],
+                    SCRATCH_BLOCK)
+    off = jnp.where(keep, pos % bs, 0)
+    span = jnp.arange(w * bs)
+    valid = span[None, :] <= pos[:, None]                   # (C, W*bs)
+    if cfg.attn_window > 0:
+        valid = valid & (span[None, :] > pos[:, None] - cfg.attn_window)
+    valid = valid[None, None, None, :, :]
+    new_pools = []
+    for p, pool in zip(params["blocks"], pools):
+        h = T._norm(p["ln1"], x, cfg)
+        q, k, v = T._qkv(p, h, cfg)
+        if cfg.rope:
+            q = T.rope_rotate(q, pos, cfg.rope_theta)
+            k = T.rope_rotate(k, pos, cfg.rope_theta)
+        pool = {**pool, **write_rows(pool, k[0], v[0], blk, off, quant)}
+        a = masked_attention(q, gather_table(pool, bt), valid, cfg)
+        x = x + T._dense(p["proj"], a.reshape(1, c, cfg.d_model))
+        h = T._norm(p["ln2"], x, cfg)
+        x, _aux = T._ffn(p, x, cfg, h)
+        new_pools.append(pool)
+    x = T._norm(params["ln_f"], x, cfg)
+    x_last = jax.lax.dynamic_index_in_dim(x, n_tok - 1, 1, False)
+    logits = T.head_logits(params, x_last, cfg).astype(jnp.float32)
+    return logits, new_pools
+
+
+class _Req:
+    """Host-side request state (never crosses into a trace)."""
+
+    __slots__ = ("rid", "prompt", "max_new", "temp", "seed", "arrival",
+                 "generated", "n_preempt", "phase", "slot", "ctx",
+                 "table", "written", "admit_seq", "admit_t",
+                 "queued_at", "wait_s", "first_tok_t", "last_tok")
+
+    def __init__(self, rid, prompt, max_new, temp, seed, arrival):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.temp = float(temp)
+        self.seed = int(seed)
+        self.arrival = arrival
+        self.generated: list[int] = []
+        self.n_preempt = 0
+        self.phase = "queued"           # queued -> prefill -> decode
+        self.slot = None
+        self.ctx = prompt               # prompt (+ generated on requeue)
+        self.table: list[int] = []
+        self.written = 0                # cache positions filled
+        self.admit_seq = -1
+        self.admit_t = None
+        self.queued_at = arrival        # start of the CURRENT queue stint
+        self.wait_s = 0.0               # queue time over every stint
+        self.first_tok_t = None
+        self.last_tok = 0
+
+
+class ServingEngine:
+    """Paged-cache continuous-batching decode server (module
+    docstring). `submit`/`poll`/`step`/`run` are the programmatic API
+    `serve.py` drives; `metrics` (a `metrics.MetricsLogger`) receives
+    the schema-v6 `"request"` events and periodic `"generate"` tick
+    lines."""
+
+    def __init__(self, params, cfg: T.TransformerConfig, *,
+                 n_blocks: int = 64, block_size: int = 16,
+                 max_slots: int = 4, prefill_chunk: int = 32,
+                 table_bucket: int = 4, kv_quant: str = "",
+                 top_k: int = 0, top_p: float = 0.0, metrics=None,
+                 log_every: int = 0, clock=time.time):
+        self.params = params
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.max_slots = int(max_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.table_bucket = int(table_bucket)
+        self.kv_quant = kv_quant
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.metrics = metrics
+        self.log_every = int(log_every)
+        self.clock = clock
+        self.pools = init_block_pool(cfg, n_blocks, block_size, kv_quant)
+        self.alloc = BlockAllocator(n_blocks)
+        self._p_bytes = param_read_bytes(params, cfg)  # constant term
+        self.slots: list[_Req | None] = [None] * self.max_slots
+        self.queue: deque[_Req] = deque()
+        self.results: dict[str, np.ndarray] = {}
+        self.request_records: list[dict] = []
+        self.counters = {"submitted": 0, "finished": 0, "preempted": 0,
+                         "ticks": 0, "prefill_chunks": 0}
+        self._admit_counter = 0
+        self._win_tokens = 0            # tokens since the last log line
+        self._win_t = clock()
+        self._last_touched = 0
+
+    # ------------------------------------------------------ public API
+
+    def submit(self, prompt, max_new: int, temperature: float = 0.0,
+               seed: int = 0, rid: str | None = None) -> str:
+        """Queue one request. Rejects (typed ValueError) requests that
+        could never run: prompt + max_new past cfg.max_seq, or a block
+        footprint larger than the whole pool (the no-deadlock
+        precondition — an admitted request can always finish alone)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tp = prompt.shape[0]
+        if tp < 1 or max_new < 1:
+            raise ValueError(f"empty request: prompt {tp} tokens, "
+                             f"max_new={max_new}")
+        if tp + max_new > self.cfg.max_seq:
+            raise ValueError(f"prompt {tp} + max_new {max_new} exceeds "
+                             f"max_seq={self.cfg.max_seq}")
+        # the final sampled token is never written (sample-after-decode,
+        # like generate()), so the request's peak footprint is
+        # tp + max_new - 1 cache positions
+        need = blocks_for(tp + max_new - 1, self.block_size)
+        if need > self.alloc.n_usable:
+            raise ValueError(
+                f"request needs {need} blocks but the pool holds "
+                f"{self.alloc.n_usable} usable — it could never be "
+                f"scheduled (raise n_blocks or shrink the request)")
+        rid = rid if rid is not None else f"r{self.counters['submitted']}"
+        if rid in self.results or any(
+                r.rid == rid for r in self._all_live()):
+            raise ValueError(f"duplicate request id {rid!r}")
+        self.queue.append(_Req(rid, prompt, max_new, temperature, seed,
+                               self.clock()))
+        self.counters["submitted"] += 1
+        return rid
+
+    def poll(self, rid: str) -> dict:
+        """{"status": queued|running|done, "tokens": generated so far}."""
+        if rid in self.results:
+            return {"status": "done", "tokens": self.results[rid]}
+        for r in self._all_live():
+            if r.rid == rid:
+                status = "queued" if r.phase == "queued" else "running"
+                return {"status": status,
+                        "tokens": np.asarray(r.generated, np.int32)}
+        raise KeyError(rid)
+
+    def pending(self) -> int:
+        return len(self.queue) + sum(1 for s in self.slots
+                                     if s is not None)
+
+    def step(self) -> bool:
+        """One scheduler tick: admissions, ONE prefill chunk (FIFO
+        across prefilling requests), one decode tick over every
+        decoding slot. Returns whether any work ran — decodes advance
+        every step even while a long prompt prefills, which is the
+        chunked-prefill no-stall contract."""
+        did = self._admit()
+        did = self._prefill_step() or did
+        did = self._decode_step() or did
+        return did
+
+    def run(self, max_steps: int | None = None) -> dict:
+        """Drain: step until every submitted request finished (or
+        `max_steps`, for bounded tests). Returns {rid: tokens}."""
+        steps = 0
+        while self.pending():
+            if max_steps is not None and steps >= max_steps:
+                break
+            if not self.step():
+                raise RuntimeError(
+                    "scheduler made no progress with requests pending "
+                    f"(queue={len(self.queue)}, "
+                    f"free_blocks={self.alloc.n_free})")
+            steps += 1
+        return dict(self.results)
+
+    def executable_counts(self) -> dict:
+        """Live jit-cache sizes of the serving entrypoints — the
+        compile-count pin (`fn._cache_size`, the same counter the
+        analysis retrace rule and RunTelemetry read). After warmup
+        these must NOT grow as requests churn."""
+        return {"decode_tick": int(_decode_tick._cache_size()),
+                "prefill_chunk": int(_prefill_chunk._cache_size()),
+                "sample": int(_sample_jit._cache_size())}
+
+    # ------------------------------------------------------- scheduler
+
+    def _all_live(self):
+        yield from (s for s in self.slots if s is not None)
+        yield from self.queue
+
+    def _admit(self) -> bool:
+        did = False
+        while self.queue and None in self.slots:
+            req = self.queue[0]
+            need = blocks_for(len(req.ctx), self.block_size)
+            try:
+                table = self.alloc.alloc(need)
+            except OutOfBlocks:
+                break                # wait for blocks to free
+            self.queue.popleft()
+            slot = self.slots.index(None)
+            req.slot = slot
+            req.table = table
+            req.written = 0
+            req.phase = "prefill"
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            req.admit_t = self.clock()
+            # queue wait accumulates PER STINT (a preempted request's
+            # on-device time between stints must not count as waiting)
+            req.wait_s += req.admit_t - req.queued_at
+            self.slots[slot] = req
+            did = True
+        return did
+
+    def _prefill_step(self) -> bool:
+        pre = [r for r in self.slots
+               if r is not None and r.phase == "prefill"]
+        if not pre:
+            return False
+        req = min(pre, key=lambda r: r.admit_seq)     # FIFO
+        c = self.prefill_chunk
+        n_tok = min(c, len(req.ctx) - req.written)
+        tokens = np.zeros((1, c), np.int32)
+        tokens[0, :n_tok] = req.ctx[req.written:req.written + n_tok]
+        w = table_width(len(req.table), self.table_bucket)
+        bt = np.full((1, w), SCRATCH_BLOCK, np.int32)
+        bt[0, :len(req.table)] = req.table
+        logits, self.pools = _prefill_chunk(
+            self.params, self.pools, tokens, np.int32(req.written),
+            np.int32(n_tok), bt, cfg=self.cfg)
+        req.written += n_tok
+        self.counters["prefill_chunks"] += 1
+        if req.written == len(req.ctx):
+            # prompt complete: sample this request's next token (token
+            # index len(generated) — 0 for a fresh request, the
+            # continuation index after a preemption) from the last
+            # true position's logits, exactly like generate()'s
+            # post-prefill sample
+            tok = _sample_jit(
+                logits, np.asarray([req.temp], np.float32),
+                np.asarray([req.seed], np.uint32),
+                np.asarray([len(req.generated)], np.int32),
+                top_k=self.top_k, top_p=self.top_p)
+            req.phase = "decode"
+            self._append_token(req, int(np.asarray(tok)[0]))
+        return True
+
+    def _decode_step(self) -> bool:
+        for req in [r for r in self.slots
+                    if r is not None and r.phase == "decode"]:
+            if req.slot is not None:          # not evicted meanwhile
+                self._ensure_block(req)
+        actives = [r for r in self.slots
+                   if r is not None and r.phase == "decode"]
+        if not actives:
+            return False
+        s = self.max_slots
+        bs = self.block_size
+        tok = np.zeros(s, np.int32)
+        pos = np.zeros(s, np.int32)
+        temp = np.zeros(s, np.float32)
+        seeds = np.zeros(s, np.uint32)
+        idx = np.zeros(s, np.int32)
+        w = table_width(max(len(r.table) for r in actives),
+                        self.table_bucket)
+        bt = np.full((s, w), SCRATCH_BLOCK, np.int32)
+        for r in actives:
+            tok[r.slot] = r.last_tok
+            pos[r.slot] = r.written
+            temp[r.slot] = r.temp
+            seeds[r.slot] = r.seed
+            idx[r.slot] = len(r.generated)
+            bt[r.slot, :len(r.table)] = r.table
+        nxt, self.pools = _decode_tick(
+            self.params, self.pools, tok, pos, bt, temp, seeds, idx,
+            cfg=self.cfg, top_k=self.top_k, top_p=self.top_p)
+        nxt = np.asarray(nxt)
+        self.counters["ticks"] += 1
+        self._last_touched = sum(
+            blocks_for(r.written + 1, bs) for r in actives)
+        for r in actives:
+            r.written += 1
+            self._append_token(r, int(nxt[r.slot]))
+        self._win_tokens += len(actives)
+        self._maybe_log()
+        return True
+
+    def _ensure_block(self, req) -> bool:
+        """Grow `req`'s table to cover its next write position,
+        evicting the newest-admitted running request on OOM (possibly
+        `req` itself). Returns whether `req` is still running."""
+        while req.written // self.block_size >= len(req.table):
+            try:
+                req.table.extend(self.alloc.alloc(1))
+            except OutOfBlocks:
+                live = [r for r in self.slots if r is not None]
+                victim = max(live, key=lambda r: r.admit_seq)
+                if victim is req and len(live) == 1:
+                    # submit() guarantees a lone request fits — reaching
+                    # here means the accounting broke
+                    raise RuntimeError(
+                        "allocator invariant violated: a lone request "
+                        "cannot grow its table") from None
+                self._evict(victim)
+                if victim is req:
+                    return False
+        return True
+
+    def _evict(self, req) -> None:
+        """Preempt: free the blocks NOW, re-queue at the front. The
+        request keeps its generated tokens and sampling indices — on
+        re-admission it re-prefills prompt + generated and continues
+        its stream exactly where it stopped."""
+        self.alloc.free(req.table)
+        req.table = []
+        req.written = 0
+        req.ctx = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)]) \
+            if req.generated else req.prompt
+        self.slots[req.slot] = None
+        req.slot = None
+        req.phase = "queued"
+        req.queued_at = self.clock()
+        req.n_preempt += 1
+        self.counters["preempted"] += 1
+        self.queue.appendleft(req)
+
+    def _append_token(self, req, tok: int) -> None:
+        req.generated.append(tok)
+        req.last_tok = tok
+        if req.first_tok_t is None:
+            req.first_tok_t = self.clock()
+        if len(req.generated) >= req.max_new:
+            self._finish(req)
+
+    def _finish(self, req) -> None:
+        self.alloc.free(req.table)
+        req.table = []
+        self.slots[req.slot] = None
+        self.results[req.rid] = np.asarray(req.generated, np.int32)
+        self.counters["finished"] += 1
+        now = self.clock()
+        rec = {
+            "id": req.rid,
+            "ttft_ms": round((req.first_tok_t - req.arrival) * 1e3, 3),
+            "tokens_in": int(req.prompt.shape[0]),
+            "tokens_out": len(req.generated),
+            "e2e_ms": round((now - req.arrival) * 1e3, 3),
+            "wait_ms": round(req.wait_s * 1e3, 3),
+            "queue_depth": len(self.queue),
+            "preempted": req.n_preempt,
+        }
+        if len(req.generated) > 1:
+            rec["tpot_ms"] = round(
+                (now - req.first_tok_t) * 1e3 / (len(req.generated) - 1),
+                3)
+        self.request_records.append(rec)
+        if self.metrics is not None:
+            self.metrics.log(event="request", **rec)
+
+    def _maybe_log(self) -> None:
+        if (self.metrics is None or self.log_every <= 0
+                or self.counters["ticks"] % self.log_every):
+            return
+        now = self.clock()
+        dt = max(now - self._win_t, 1e-9)
+        bpt = paged_read_bytes_per_tick(
+            self.params, self.cfg, self._last_touched, self.block_size,
+            self.max_slots, self.kv_quant, p_bytes=self._p_bytes)
+        ticks_per_sec = self.log_every / dt
+        self.metrics.log(
+            event="generate",
+            tokens_per_sec=round(self._win_tokens / dt, 2),
+            queue_depth=len(self.queue),
+            active_slots=sum(1 for r in self.slots if r is not None),
+            free_blocks=self.alloc.n_free,
+            blocks_touched=self._last_touched,
+            bytes_per_tick=int(bpt),
+            hbm_gbps=round(ticks_per_sec * bpt / 1e9, 4))
+        self._win_tokens = 0
+        self._win_t = now

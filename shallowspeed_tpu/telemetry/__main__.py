@@ -36,8 +36,10 @@ def main(argv=None) -> int:
     g.add_argument("--goodput", metavar="JSONL",
                    help="reduce one metrics JSONL to the goodput "
                         "report (wall-clock decomposition + losses, "
-                        "per-failure-class MTTR, availability, and "
-                        "the injected-fault tally on chaos drills)")
+                        "per-failure-class MTTR, availability, the "
+                        "injected-fault tally on chaos drills, and "
+                        "p50/p95 ttft/tpot on serving runs with "
+                        "schema-v6 request events)")
     args = p.parse_args(argv)
 
     if args.regress:

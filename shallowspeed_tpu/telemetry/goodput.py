@@ -158,6 +158,11 @@ def run_goodput(path) -> dict:
     # injected next to what it cost.
     mttr: dict[str, dict] = {}
     faults: dict[str, int] = {}
+    # schema v6: serving request-completion stamps reduce to the SLO
+    # percentiles (p50/p95 ttft and tpot) — a serving run's metrics
+    # JSONL answers "how fast were the requests" through the same
+    # reducer that answers "where did the wall clock go"
+    request_recs = [r for r in recs if r.get("event") == "request"]
     for rec in recs:
         if rec.get("event") == "fault" and isinstance(rec.get("kind"),
                                                       str):
@@ -311,7 +316,16 @@ def run_goodput(path) -> dict:
         "per_step_s": (round(per_step, 6) if per_step is not None
                        else None),
         "stanzas": len(stanzas),
+        # None for training runs (no request events) — the serving
+        # block appears only when the JSONL carries schema-v6 stamps
+        "requests": _request_block(request_recs),
     }
+
+
+def _request_block(request_recs) -> dict | None:
+    from shallowspeed_tpu.telemetry.report import request_summary
+
+    return request_summary(request_recs)
 
 
 def format_report(rep: dict) -> str:
@@ -338,6 +352,19 @@ def format_report(rep: dict) -> str:
             + (f"  {aborts}" if aborts else ""))
     if rep.get("faults"):
         lines.append(f"injected faults: {rep['faults']}")
+    req = rep.get("requests")
+    if req:
+        def ms(v):
+            return "—" if v is None else f"{v:.1f}"
+
+        lines.append(
+            f"requests {req['n_requests']}  "
+            f"ttft p50/p95 {ms(req['ttft_ms_p50'])}/"
+            f"{ms(req['ttft_ms_p95'])} ms  "
+            f"tpot p50/p95 {ms(req['tpot_ms_p50'])}/"
+            f"{ms(req['tpot_ms_p95'])} ms  "
+            f"tokens {req['tokens_in']}->{req['tokens_out']}  "
+            f"preempted {req['preempted']}")
     if rep.get("availability") is not None:
         lines.append(f"availability {rep['availability']:.2%}")
     lines.append(f"accounted {rep['accounted_frac'] if rep['accounted_frac'] is not None else '—'}"

@@ -34,6 +34,44 @@ from shallowspeed_tpu.telemetry import collectives, memory
 MiB = float(1 << 20)
 
 
+def percentile(vals, q: float) -> float | None:
+    """Nearest-rank percentile (q in [0, 100]) without numpy dtype
+    surprises — None on empty input. Shared by the request-latency
+    summary below and the goodput reducer's serving block."""
+    vals = sorted(float(v) for v in vals)
+    if not vals:
+        return None
+    k = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+    return vals[k]
+
+
+def request_summary(recs) -> dict | None:
+    """Reduce schema-v6 `"request"` records (dicts with ttft_ms /
+    tpot_ms / tokens_* / preempted — the serving engine's completion
+    stamps) to the SLO headline: p50/p95 time-to-first-token and
+    time-per-output-token, total tokens moved, preemption count.
+    Returns None when there are no request records, so training-run
+    summaries stay unchanged."""
+    recs = [r for r in recs if isinstance(r, dict) and "ttft_ms" in r]
+    if not recs:
+        return None
+    ttft = [r["ttft_ms"] for r in recs
+            if isinstance(r.get("ttft_ms"), (int, float))]
+    tpot = [r["tpot_ms"] for r in recs
+            if isinstance(r.get("tpot_ms"), (int, float))]
+    rnd = lambda v: None if v is None else round(v, 3)  # noqa: E731
+    return {
+        "n_requests": len(recs),
+        "ttft_ms_p50": rnd(percentile(ttft, 50)),
+        "ttft_ms_p95": rnd(percentile(ttft, 95)),
+        "tpot_ms_p50": rnd(percentile(tpot, 50)),
+        "tpot_ms_p95": rnd(percentile(tpot, 95)),
+        "tokens_in": sum(int(r.get("tokens_in", 0)) for r in recs),
+        "tokens_out": sum(int(r.get("tokens_out", 0)) for r in recs),
+        "preempted": sum(int(r.get("preempted", 0)) for r in recs),
+    }
+
+
 def sds(tree):
     """Shape/dtype skeleton of a pytree (targets.py's `_sds` contract:
     safe to trace, can never alias live buffers)."""

@@ -37,11 +37,18 @@ from pathlib import Path
 # round 10): `"fault"` events stamped at every injected fault, and
 # the `fail_class` field on supervisor-stamped ledger lines
 # (restart_downtime / poison_step_abort / supervisor_abort) that the
-# goodput reducer turns into per-failure-class MTTR. The validator
-# accepts ALL dialects — every versioned field is optional, so
-# committed v1-v4 artifacts (no version stamp / no health / overlap /
-# attrib / wall / fault fields) keep validating unchanged.
-SCHEMA_VERSION = 5
+# goodput reducer turns into per-failure-class MTTR; 6 = v5 plus the
+# serving extension (round 11, `shallowspeed_tpu/serving/`):
+# `"request"` events — one per completed request, carrying the
+# per-request SLO record (ttft_ms, tpot_ms, queue depth at
+# completion, preemption count, tokens in/out) the `--goodput`
+# reducer turns into p50/p95 ttft/tpot — and the serving fields the
+# periodic `"generate"` tick lines grew (queue_depth, active_slots,
+# free_blocks, the live-blocks HBM sweep). The validator accepts ALL
+# dialects — every versioned field is optional, so committed v1-v5
+# artifacts (no version stamp / no health / overlap / attrib / wall /
+# fault / request fields) keep validating unchanged.
+SCHEMA_VERSION = 6
 
 _NUM = (int, float)
 
@@ -67,6 +74,11 @@ _METRIC_EVENTS = {
     # chaos.py) — the forensic record of what was injected when,
     # fsync'd into the same JSONL the step lines live in
     "fault": {"kind": str},
+    # schema v6: one line per COMPLETED serving request
+    # (serving/engine.ServingEngine._finish) — the per-request SLO
+    # record the --goodput reducer turns into ttft/tpot percentiles
+    "request": {"id": str, "ttft_ms": _NUM, "tokens_in": int,
+                "tokens_out": int},
 }
 
 # optional typed fields on a "ledger" line (`fail_class`: the
@@ -77,6 +89,12 @@ _LEDGER_OPTIONAL = {"seconds": _NUM, "count": int, "fail_class": str}
 _FAULT_OPTIONAL = {"step": int, "save": int, "seconds": _NUM,
                    "leaf": int, "fault_id": str, "point": str,
                    "path": str, "mode": str}
+
+# optional typed fields on a "request" line (schema v6). tpot_ms is
+# absent (not null) for single-token generations — there is no
+# inter-token interval to average
+_REQUEST_OPTIONAL = {"tpot_ms": _NUM, "e2e_ms": _NUM, "wait_ms": _NUM,
+                     "queue_depth": int, "preempted": int}
 
 # telemetry fields a step line MAY carry; when present they must type
 _STEP_TELEMETRY = {
@@ -150,6 +168,12 @@ def _validate_metric(rec: dict) -> list[str]:
             if field in rec and (not isinstance(rec[field], typ)
                                  or isinstance(rec[field], bool)):
                 probs.append(f"fault: field {field!r} is "
+                             f"{type(rec[field]).__name__}")
+    if ev == "request":
+        for field, typ in _REQUEST_OPTIONAL.items():
+            if field in rec and (not isinstance(rec[field], typ)
+                                 or isinstance(rec[field], bool)):
+                probs.append(f"request: field {field!r} is "
                              f"{type(rec[field]).__name__}")
     # schema v4: any metrics line may carry an absolute `wall` stamp
     if "wall" in rec and not isinstance(rec["wall"], _NUM):

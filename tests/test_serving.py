@@ -1,0 +1,479 @@
+"""Serving runtime (`shallowspeed_tpu/serving/`): paged KV cache +
+continuous-batching decode server.
+
+The load-bearing invariants:
+
+- **Stream parity.** Every request served concurrently reproduces its
+  solo `generate()` token stream exactly (fixed seeds, greedy AND
+  sampled) — paged attention shares `kv_cache.masked_attention` with
+  the contiguous path and sampling shares the per-request
+  `fold_in(PRNGKey(seed), token_index)` key schedule.
+- **Compile hygiene.** Requests join/leave the running batch with ZERO
+  new executables after warmup (fixed slot capacity, geometric
+  block-table width buckets, donated pools) — the serving analog of
+  `test_vm_executables_compile_exactly_once`.
+- **Chunked prefill.** A long prompt admitted mid-run never freezes
+  in-flight decodes for more than one chunk tick.
+- **Allocator soundness.** alloc == free at drain; OOM evicts the
+  newest running request (re-queued, stream continues exactly) and
+  can never deadlock.
+"""
+
+import json
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.models import transformer as T
+from shallowspeed_tpu.models.generate import generate, init_kv_cache, prefill
+from shallowspeed_tpu.serving import (BlockAllocator, OutOfBlocks,
+                                      ServingEngine, blocks_for,
+                                      init_block_pool,
+                                      paged_read_bytes_per_tick,
+                                      table_width)
+
+CFG = T.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                          max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return jax.device_put(T.init(CFG, seed=1))
+
+
+def toks(seed=0, t=12, vocab=64):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, (t,)).astype(np.int32)
+
+
+def solo(params, prompt, max_new, cfg=CFG, **kw):
+    return np.asarray(generate(params, prompt[None, :], cfg, max_new,
+                               **kw))[0]
+
+
+# ------------------------------------------------- allocator + pools
+
+
+def test_block_allocator_invariants():
+    a = BlockAllocator(8)           # block 0 reserved -> 7 usable
+    assert a.n_usable == 7 and a.n_free == 7
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got       # scratch never issued
+    assert a.n_free == 4 and a.n_allocated == 3
+    with pytest.raises(OutOfBlocks):
+        a.alloc(5)                  # all-or-nothing: nothing leaked
+    assert a.n_free == 4
+    with pytest.raises(ValueError):
+        a.free([99])                # not allocated
+    a.free(got)
+    assert a.n_free == 7 and a.n_allocated == 0   # balanced at drain
+    with pytest.raises(ValueError):
+        BlockAllocator(1)           # nothing usable past scratch
+
+
+def test_blocks_for_and_table_width():
+    assert blocks_for(0, 16) == 0
+    assert blocks_for(1, 16) == 1
+    assert blocks_for(16, 16) == 1
+    assert blocks_for(17, 16) == 2
+    # geometric width buckets: O(log) executables as tables grow
+    assert table_width(1, 4) == 4
+    assert table_width(4, 4) == 4
+    assert table_width(5, 4) == 8
+    assert table_width(33, 4) == 64
+
+
+def test_init_block_pool_shapes_and_errors():
+    pools = init_block_pool(CFG, 8, 16)
+    assert len(pools) == CFG.n_layers
+    assert pools[0]["k"].shape == (8, CFG.kv_heads, 16, CFG.head_dim)
+    q = init_block_pool(CFG, 8, 16, kv_quant="int8")
+    assert q[0]["k"].dtype == jnp.int8
+    assert q[0]["k_s"].shape == (8, CFG.kv_heads, 16, 1)
+    with pytest.raises(ValueError, match="kv_quant"):
+        init_block_pool(CFG, 8, 16, kv_quant="fp4")
+    with pytest.raises(ValueError, match="n_blocks"):
+        init_block_pool(CFG, 1, 16)
+
+
+# ------------------------------------- satellites: typed errors, asarray
+
+
+def test_init_kv_cache_rejects_unknown_quant_mode():
+    """Satellite: the bare `assert kv_quant == "int8"` became a typed
+    ValueError naming the supported modes — asserts vanish under
+    `python -O`, and this gate guards a production cache layout."""
+    with pytest.raises(ValueError, match="int8"):
+        init_kv_cache(CFG, 2, kv_quant="fp8")
+    assert init_kv_cache(CFG, 1, cache_len=8, kv_quant="int8")
+
+
+def test_decode_report_rejects_nonpositive_inputs(params):
+    from shallowspeed_tpu.models.generate import decode_report
+
+    with pytest.raises(ValueError, match="seconds"):
+        decode_report(params, CFG, batch=1, cache_len=8, n_tokens=4,
+                      seconds=0.0)
+    with pytest.raises(ValueError, match="n_tokens"):
+        decode_report(params, CFG, batch=1, cache_len=8, n_tokens=0,
+                      seconds=1.0)
+
+
+def test_generate_converts_prompt_on_no_padding_branch(params):
+    """Satellite: `generate` now runs `jnp.asarray` on BOTH branches.
+    A prompt whose bucket equals its length (tp == tp_b: the
+    no-padding branch) used to pass the caller's raw array straight
+    into jit — an int64 host array must normalize identically on both
+    branches."""
+    # max_seq 128, max_new 104 -> bucket cap = 24 == tp: no padding
+    p32 = toks(3, t=24)
+    p64 = p32.astype(np.int64)
+    a = solo(params, p32, 8, temperature=0.0)
+    b = np.asarray(generate(params, p64[None, :], CFG, 8,
+                            temperature=0.0))[0]
+    np.testing.assert_array_equal(a, b)
+    # padded branch, same dtypes
+    c = solo(params, toks(3, t=10), 8, temperature=0.0)
+    d = np.asarray(generate(params, toks(3, t=10).astype(np.int64)[None],
+                            CFG, 8, temperature=0.0))[0]
+    np.testing.assert_array_equal(c, d)
+
+
+# -------------------------------------- paged vs contiguous numerics
+
+
+def test_prefill_chunk_logits_match_contiguous_prefill(params):
+    """The paged prefill's last-position logits match the contiguous
+    `prefill`'s to 1e-4 — same cache math read through the gathered
+    block table (`kv_cache.masked_attention` is shared)."""
+    from shallowspeed_tpu.serving.engine import _prefill_chunk
+
+    prompt = toks(5, t=14)
+    ref, _ = prefill(params, jnp.asarray(prompt[None]), CFG,
+                     init_kv_cache(CFG, 1, cache_len=32))
+    # pool/chunk/width shapes shared with the engine tests below, so
+    # this compiles (at most) once per suite run
+    pools = init_block_pool(CFG, 32, 8)
+    alloc = BlockAllocator(32)
+    table = alloc.alloc(blocks_for(14, 8))
+    c = 16
+    tokens = np.zeros((1, c), np.int32)
+    tokens[0, :14] = prompt
+    bt = np.zeros((1, table_width(len(table), 4)), np.int32)
+    bt[0, :len(table)] = table
+    logits, pools = _prefill_chunk(params, pools, tokens, np.int32(0),
+                                   np.int32(14), bt, cfg=CFG)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["f32", "int8"])
+def test_paged_attention_matches_cached_attention(params, quant):
+    """Block-gathered attention == contiguous `_cached_attention` on
+    identical cache contents (to fp-reorder noise): the read path's
+    only difference is the gather. int8 pools quantize per (row, head,
+    position) exactly like the contiguous int8 cache, so the parity
+    holds there too — the default-tier int8 canary (the full int8
+    stream oracle rides the slow tier)."""
+    from shallowspeed_tpu.models.kv_cache import (cache_write,
+                                                  cached_attention,
+                                                  masked_attention)
+    from shallowspeed_tpu.serving.cache import gather_table, write_rows
+
+    rng = np.random.default_rng(0)
+    bs, n_pos = 8, 19
+    kv_quant = "int8" if quant else ""
+    kv = [rng.normal(size=(1, n_pos, CFG.kv_heads,
+                           CFG.head_dim)).astype(np.float32)
+          for _ in range(2)]
+    q = jnp.asarray(rng.normal(
+        size=(1, 1, CFG.n_heads, CFG.head_dim)).astype(np.float32))
+    cache = init_kv_cache(CFG, 1, cache_len=32, kv_quant=kv_quant)[0]
+    cache = cache_write(cache, jnp.asarray(kv[0]), jnp.asarray(kv[1]), 0)
+    pool = init_block_pool(CFG, 32, bs, kv_quant=kv_quant)[0]
+    table = [3, 1, 5]                      # deliberately out of order
+    for pos in range(n_pos):
+        pool = write_rows(
+            pool, jnp.asarray(kv[0][:, pos]), jnp.asarray(kv[1][:, pos]),
+            jnp.asarray([table[pos // bs]]), jnp.asarray([pos % bs]),
+            quant=quant)
+    bt = jnp.asarray([table + [0]], jnp.int32)       # padded width 4
+    pos = n_pos - 1
+    ref = cached_attention(q, cache, pos, CFG)
+    view = gather_table(pool, bt)
+    valid = (jnp.arange(4 * bs) <= pos)[None, None, None, None, :]
+    got = masked_attention(q, view, valid, CFG)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------- stream-parity oracle
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"temperature": 0.0},
+    {"temperature": 1.0, "seed": 7},
+    {"temperature": 0.7, "seed": 3},
+], ids=["greedy", "sampled", "temp0.7"])
+def test_solo_request_matches_generate(params, kwargs):
+    """A request served alone reproduces its `generate()` stream
+    token-for-token — the continuous-batching correctness oracle's
+    base case, greedy and sampled (same fold_in key schedule)."""
+    prompt = toks(11, t=13)
+    ref = solo(params, prompt, 10, **kwargs)
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    eng.submit(prompt, 10, temperature=kwargs.get("temperature", 0.0),
+               seed=kwargs.get("seed", 0), rid="q")
+    res = eng.run()
+    np.testing.assert_array_equal(res["q"], ref)
+    assert eng.alloc.n_free == eng.alloc.n_usable
+
+
+def test_concurrent_mixed_lengths_match_solo_oracles(params):
+    """N concurrent requests with different prompt lengths, max_new,
+    and samplers — including one submitted MID-RUN (joins the running
+    batch) — each reproduce their solo stream exactly."""
+    # max_new=10 signatures deliberately match the solo-parity test's
+    # compiled generate() oracles (warm jit cache); 12 and 6 are fresh
+    reqs = {
+        "a": (toks(0, t=5), 10, 0.0, 0),
+        "b": (toks(1, t=23), 12, 1.0, 7),
+        "c": (toks(2, t=40), 6, 0.0, 0),
+        "late": (toks(3, t=17), 10, 1.0, 11),
+    }
+    oracle = {k: solo(params, p, mn, temperature=tmp, seed=s)
+              for k, (p, mn, tmp, s) in reqs.items()}
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    for k in ("a", "b", "c"):
+        p, mn, tmp, s = reqs[k]
+        eng.submit(p, mn, temperature=tmp, seed=s, rid=k)
+    for _ in range(4):                     # a/b/c already decoding...
+        eng.step()
+    p, mn, tmp, s = reqs["late"]
+    eng.submit(p, mn, temperature=tmp, seed=s, rid="late")  # ...joins
+    res = eng.run()
+    for k, ref in oracle.items():
+        np.testing.assert_array_equal(res[k], ref, err_msg=k)
+    assert eng.alloc.n_free == eng.alloc.n_usable
+
+
+def test_zero_recompiles_across_request_churn(params):
+    """After warmup, requests joining and leaving the batch add ZERO
+    executables (`fn._cache_size`, the counter the analysis retrace
+    rule reads) — occupancy is data, not shape: fixed slot count,
+    geometric table-width buckets, fixed prefill chunk."""
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    # warmup: lengths walking every width bucket the churn uses
+    for i, (t, mn) in enumerate([(5, 6), (23, 8), (40, 6)]):
+        eng.submit(toks(20 + i, t=t), mn, rid=f"w{i}")
+    eng.run()
+    warm = eng.executable_counts()
+    for i, (t, mn, tmp) in enumerate(
+            [(9, 7, 0.0), (31, 5, 1.0), (14, 9, 0.0), (44, 6, 0.0),
+             (3, 8, 1.0)]):
+        eng.submit(toks(40 + i, t=t), mn, temperature=tmp, rid=f"c{i}")
+        eng.step()                  # staggered joins/leaves
+    eng.run()
+    assert eng.executable_counts() == warm, (
+        f"request churn recompiled: {warm} -> "
+        f"{eng.executable_counts()}")
+
+
+def test_chunked_prefill_never_stalls_decode(params):
+    """A long prompt admitted mid-run prefills one chunk per engine
+    step INTERLEAVED with decode ticks: an in-flight request's stream
+    advances every step (tpot bounded at one chunk tick) instead of
+    freezing for the whole prefill."""
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    eng.submit(toks(0, t=6), 40, rid="short")
+    while (eng.poll("short")["status"] != "running"
+           or len(eng.poll("short")["tokens"]) < 2):
+        eng.step()
+    eng.submit(toks(1, t=60), 4, rid="long")   # 4 chunks of prefill
+    deltas = []
+    while eng.poll("long")["status"] != "done":
+        before = len(eng.poll("short")["tokens"])
+        eng.step()
+        deltas.append(len(eng.poll("short")["tokens"]) - before)
+    assert min(deltas) >= 1, (
+        f"decode stalled during chunked prefill: per-step token "
+        f"deltas {deltas}")
+    # and the long request still matches its solo oracle
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res["long"], solo(params, toks(1, t=60), 4, temperature=0.0))
+
+
+def test_oom_evicts_requeues_and_balances(params):
+    """Pool pressure: 3 requests whose steady-state footprint exceeds
+    the pool force the evict-newest policy — the evicted request
+    re-queues, re-prefills prompt + generated, and still reproduces
+    its solo stream; the allocator balances at drain and never
+    deadlocks."""
+    reqs = {k: (toks(50 + i, t=24), 16) for i, k in enumerate("abc")}
+    oracle = {k: solo(params, p, mn, temperature=0.0)
+              for k, (p, mn) in reqs.items()}
+    # 13 usable blocks * 8 = 104 positions < 3 * (24 + 16) = 120
+    eng = ServingEngine(params, CFG, n_blocks=14, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    for k, (p, mn) in reqs.items():
+        eng.submit(p, mn, rid=k)
+    res = eng.run()
+    for k in reqs:
+        np.testing.assert_array_equal(res[k], oracle[k], err_msg=k)
+    assert eng.counters["preempted"] >= 1
+    assert eng.alloc.n_free == eng.alloc.n_usable
+    assert eng.alloc.n_allocated == 0
+    rec = {r["id"]: r for r in eng.request_records}
+    assert sum(r["preempted"] for r in rec.values()) \
+        == eng.counters["preempted"]
+
+
+def test_submit_rejects_unservable_requests(params):
+    eng = ServingEngine(params, CFG, n_blocks=8, block_size=8,
+                        max_slots=2)
+    with pytest.raises(ValueError, match="max_seq"):
+        eng.submit(toks(0, t=100), 64)
+    with pytest.raises(ValueError, match="blocks"):
+        eng.submit(toks(0, t=60), 40)   # 13 blocks > 7 usable
+    eng.submit(toks(0, t=8), 4, rid="ok")
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.submit(toks(0, t=8), 4, rid="ok")
+
+
+def test_int8_paged_matches_solo_int8_stream(params):
+    """int8 pools quantize per (row, head, position) exactly like the
+    contiguous int8 cache, so a greedy paged stream matches the solo
+    `generate(kv_quant='int8')` stream."""
+    prompt = toks(7, t=18)
+    ref = solo(params, prompt, 10, temperature=0.0, kv_quant="int8")
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16, kv_quant="int8")
+    eng.submit(prompt, 10, rid="q")
+    np.testing.assert_array_equal(eng.run()["q"], ref)
+
+
+def test_gqa_rope_swiglu_config_parity(params):
+    """The serving tick's per-row rope + GQA pools reproduce the solo
+    stream on a modern block config (rope, rmsnorm, swiglu, grouped
+    KV heads)."""
+    cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                              n_kv_heads=2, n_layers=2, max_seq=96,
+                              rope=True, norm="rmsnorm", ffn="swiglu")
+    p2 = jax.device_put(T.init(cfg, seed=2))
+    prompt = toks(9, t=19)
+    for kwargs in ({"temperature": 0.0}, {"temperature": 1.0, "seed": 5}):
+        ref = solo(p2, prompt, 8, cfg=cfg, **kwargs)
+        eng = ServingEngine(p2, cfg, n_blocks=24, block_size=8,
+                            max_slots=2, prefill_chunk=16)
+        eng.submit(prompt, 8, temperature=kwargs.get("temperature", 0.0),
+                   seed=kwargs.get("seed", 0), rid="q")
+        np.testing.assert_array_equal(eng.run()["q"], ref,
+                                      err_msg=str(kwargs))
+
+
+# ------------------------------------------- telemetry: schema v6 + SLO
+
+
+def test_request_events_validate_schema_v6(params, tmp_path):
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 6
+    path = tmp_path / "serve.jsonl"
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16,
+                        metrics=MetricsLogger(path, kind="serve"),
+                        log_every=4)
+    eng.submit(toks(0, t=9), 8, rid="a")
+    eng.submit(toks(1, t=14), 6, temperature=1.0, seed=2, rid="b")
+    eng.run()
+    assert schema.validate_file(path) == []
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    reqs = [r for r in recs if r.get("event") == "request"]
+    assert {r["id"] for r in reqs} == {"a", "b"}
+    for r in reqs:
+        assert r["ttft_ms"] >= 0 and r["tpot_ms"] >= 0
+        assert r["tokens_in"] > 0 and r["tokens_out"] > 0
+        assert "queue_depth" in r and "preempted" in r
+    gen = [r for r in recs if r.get("event") == "generate"]
+    assert gen and all("hbm_gbps" in g and "free_blocks" in g
+                       for g in gen)
+    # malformed request lines are rejected
+    assert schema.validate_line({"event": "request", "id": "x"}) != []
+    assert schema.validate_line(
+        {"event": "request", "id": "x", "ttft_ms": 1.0, "tokens_in": 1,
+         "tokens_out": 1, "queue_depth": "deep"}) != []
+
+
+def test_goodput_reduces_request_percentiles(params, tmp_path):
+    """The `--goodput` reducer reports p50/p95 ttft and tpot from the
+    schema-v6 request events, and the formatted report prints them."""
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.telemetry.goodput import (format_report,
+                                                    run_goodput)
+
+    path = tmp_path / "serve.jsonl"
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16,
+                        metrics=MetricsLogger(path, kind="serve"))
+    for i in range(4):
+        eng.submit(toks(i, t=7 + 5 * i), 6, rid=f"r{i}")
+    eng.run()
+    rep = run_goodput(path)
+    req = rep["requests"]
+    assert req["n_requests"] == 4
+    assert req["ttft_ms_p50"] <= req["ttft_ms_p95"]
+    assert req["tpot_ms_p50"] <= req["tpot_ms_p95"]
+    assert req["tokens_out"] == 24
+    assert "requests 4" in format_report(rep)
+
+
+def test_request_summary_percentiles():
+    from shallowspeed_tpu.telemetry.report import (percentile,
+                                                   request_summary)
+
+    assert request_summary([]) is None
+    assert percentile([], 50) is None
+    recs = [{"ttft_ms": float(i), "tpot_ms": float(10 * i),
+             "tokens_in": 2, "tokens_out": 3, "preempted": i % 2}
+            for i in range(1, 21)]
+    s = request_summary(recs)
+    assert s["n_requests"] == 20
+    assert s["ttft_ms_p50"] == pytest.approx(10.0, abs=1.0)
+    assert s["ttft_ms_p95"] == pytest.approx(19.0, abs=1.0)
+    assert s["tpot_ms_p95"] == pytest.approx(190.0, abs=10.0)
+    assert s["tokens_out"] == 60 and s["preempted"] == 10
+    # single-token generations carry no tpot — summary degrades
+    s1 = request_summary([{"ttft_ms": 5.0, "tokens_in": 1,
+                           "tokens_out": 1}])
+    assert s1["tpot_ms_p50"] is None and s1["ttft_ms_p50"] == 5.0
+
+
+def test_paged_read_bytes_per_tick_model(params):
+    """The live-blocks HBM model: params once + touched blocks' K/V
+    (+ int8 scales) + token ids — the serving generalization of
+    `decode_read_bytes_per_token`'s full-cache sweep."""
+    from shallowspeed_tpu.analysis.walker import aval_bytes
+
+    cast = jax.eval_shape(
+        lambda p: T.cast_params(p, CFG.compute_dtype), params)
+    p_bytes = int(sum(aval_bytes(l)
+                      for l in jax.tree_util.tree_leaves(cast)))
+    bs, touched, rows = 16, 5, 4
+    got = paged_read_bytes_per_tick(params, CFG, touched, bs, rows)
+    per_block = 2 * CFG.kv_heads * bs * CFG.head_dim * 4  # f32 cache
+    assert got == p_bytes + CFG.n_layers * touched * per_block + rows * 4
+    q = paged_read_bytes_per_tick(params, CFG, touched, bs, rows,
+                                  kv_quant="int8")
+    per_block_q = (2 * CFG.kv_heads * bs * CFG.head_dim
+                   + 2 * CFG.kv_heads * bs * 4)
+    assert q == p_bytes + CFG.n_layers * touched * per_block_q + rows * 4
+    assert q < got                      # int8 sweeps fewer bytes
