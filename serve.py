@@ -67,6 +67,25 @@ def parse_args(argv=None):
                    help="metrics JSONL (request/generate events)")
     p.add_argument("--log-every", type=int, default=16,
                    help="decode ticks between 'generate' stat lines")
+    o = p.add_argument_group("live monitoring (telemetry/monitor)")
+    o.add_argument("--monitor-port", type=int, default=None,
+                   help="serve /status.json + /metrics (Prometheus "
+                        "text) on 127.0.0.1:PORT while the run is "
+                        "live (0 = pick a free port, printed at start)")
+    o.add_argument("--slo", default="",
+                   help="declarative SLOs evaluated over dual burn-"
+                        "rate windows, e.g. "
+                        "'ttft_p95_ms<500,availability>0.99'; state "
+                        "transitions land as schema-v7 'alert' events")
+    o.add_argument("--flight-recorder", type=int, default=0,
+                   help="keep the last N metrics/span records in a "
+                        "ring and dump flightrec_<step>.json on an "
+                        "anomaly verdict, chaos fault, or SLO alert "
+                        "(0 = off)")
+    o.add_argument("--shed-load", action="store_true",
+                   help="wire SLO alerts into Engine.on_alert: pause "
+                        "admission while a critical burn persists "
+                        "(default: alerts are telemetry-only)")
     p.add_argument("--platform", default=None,
                    help="jax platform override (e.g. cpu)")
     return p.parse_args(argv)
@@ -115,10 +134,18 @@ def main(argv=None) -> int:
     import jax
     import numpy as np
 
+    from shallowspeed_tpu.elastic import install_sigterm_exit
     from shallowspeed_tpu.metrics import MetricsLogger
     from shallowspeed_tpu.models import transformer as T
     from shallowspeed_tpu.serving import ServingEngine
     from shallowspeed_tpu.telemetry.report import request_summary
+
+    # supervisor kill path (same contract as the train drivers):
+    # SIGTERM becomes SystemExit so the finally block below flushes
+    # the request/ledger tail and the final summary line before the
+    # supervisor's SIGKILL deadline — a killed server must leave a
+    # reducible metrics file, not a truncated one
+    install_sigterm_exit()
 
     cfg = T.TransformerConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
@@ -144,51 +171,75 @@ def main(argv=None) -> int:
         top_k=args.top_k, top_p=args.top_p, metrics=metrics,
         log_every=args.log_every)
 
+    # live telemetry plane: /status.json + /metrics endpoint, SLO
+    # burn-rate alerts (optionally shedding load via Engine.on_alert),
+    # anomaly flight recorder — all fed by the same metrics lines the
+    # JSONL gets (MetricsLogger.monitor)
+    from shallowspeed_tpu.telemetry.monitor import (close_monitor,
+                                                    from_args)
+
+    mon, server = from_args(args, metrics)
+    if server is not None:
+        print(json.dumps({"event": "monitor_listening",
+                          "url": server.url("/status.json")}),
+              flush=True)
+    if mon is not None and args.shed_load:
+        mon.alert_listeners.append(eng.on_alert)
+
     t0 = time.time()
     i = 0
     reported: set[str] = set()
-    while i < len(reqs) or eng.pending():
-        now = time.time() - t0
-        while i < len(reqs) and reqs[i]["at"] <= now:
-            r = reqs[i]
-            i += 1
-            try:
-                eng.submit(r["prompt"], r["max_new"],
-                           temperature=r.get("temperature", 0.0),
-                           seed=r.get("seed", 0), rid=r["id"])
-            except (KeyError, TypeError, ValueError) as e:
-                # one bad request (too long for max_seq/pool, duplicate
-                # id, missing/mistyped fields) must not kill the server
-                # — report it and keep draining the rest
-                print(json.dumps({"event": "error", "id": r["id"],
-                                  "error": f"{type(e).__name__}: {e}"}))
-        if eng.pending():
-            eng.step()
-        elif i < len(reqs):
-            time.sleep(min(0.05, max(0.0, reqs[i]["at"] - now)))
-        for rec in eng.request_records[len(reported):]:
-            reported.add(rec["id"])
-            print(json.dumps({
-                "event": "result", "id": rec["id"],
-                "tokens": [int(t) for t in eng.results[rec["id"]]],
-                "ttft_ms": rec["ttft_ms"],
-                "tpot_ms": rec.get("tpot_ms")}))
-    wall = time.time() - t0
-
-    summary = request_summary(eng.request_records) or {}
-    summary.update({
-        "wall_s": round(wall, 3),
-        "tok_per_sec": round(
-            sum(r["tokens_out"] for r in eng.request_records)
-            / max(wall, 1e-9), 2),
-        "ticks": eng.counters["ticks"],
-        "prefill_chunks": eng.counters["prefill_chunks"],
-        "preemptions": eng.counters["preempted"],
-        "executables": eng.executable_counts(),
-        "blocks_free_at_drain":
-            f"{eng.alloc.n_free}/{eng.alloc.n_usable}",
-    })
-    print(json.dumps({"event": "summary", **summary}))
+    try:
+        while i < len(reqs) or eng.pending():
+            now = time.time() - t0
+            while i < len(reqs) and reqs[i]["at"] <= now:
+                r = reqs[i]
+                i += 1
+                try:
+                    eng.submit(r["prompt"], r["max_new"],
+                               temperature=r.get("temperature", 0.0),
+                               seed=r.get("seed", 0), rid=r["id"])
+                except (KeyError, TypeError, ValueError) as e:
+                    # one bad request (too long for max_seq/pool,
+                    # duplicate id, missing/mistyped fields) must not
+                    # kill the server — report it and keep draining
+                    print(json.dumps(
+                        {"event": "error", "id": r["id"],
+                         "error": f"{type(e).__name__}: {e}"}))
+            if eng.pending():
+                eng.step()
+            elif i < len(reqs):
+                time.sleep(min(0.05, max(0.0, reqs[i]["at"] - now)))
+            for rec in eng.request_records[len(reported):]:
+                reported.add(rec["id"])
+                print(json.dumps({
+                    "event": "result", "id": rec["id"],
+                    "tokens": [int(t) for t in eng.results[rec["id"]]],
+                    "ttft_ms": rec["ttft_ms"],
+                    "tpot_ms": rec.get("tpot_ms")}))
+    finally:
+        # reached on clean drain AND on the SIGTERM SystemExit: the
+        # summary line + the monitor's final sketch snapshot land in
+        # the outputs either way, so a supervisor-killed server still
+        # reduces (--goodput) and merges (schema-v7 monitor events)
+        wall = time.time() - t0
+        summary = request_summary(eng.request_records) or {}
+        summary.update({
+            "wall_s": round(wall, 3),
+            "tok_per_sec": round(
+                sum(r["tokens_out"] for r in eng.request_records)
+                / max(wall, 1e-9), 2),
+            "ticks": eng.counters["ticks"],
+            "prefill_chunks": eng.counters["prefill_chunks"],
+            "preemptions": eng.counters["preempted"],
+            "shed_toggles": eng.counters["shed_toggles"],
+            "pending_at_exit": eng.pending(),
+            "executables": eng.executable_counts(),
+            "blocks_free_at_drain":
+                f"{eng.alloc.n_free}/{eng.alloc.n_usable}",
+        })
+        print(json.dumps({"event": "summary", **summary}), flush=True)
+        close_monitor(mon, server)
     return 0
 
 

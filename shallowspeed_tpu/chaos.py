@@ -229,10 +229,19 @@ class FaultPlan:
         """Append the schema-v5 fault event to the metrics JSONL,
         fsync'd — a kill fault dies microseconds later and the forensic
         record must already be durable. Best effort: injecting a fault
-        must never crash the run in an unplanned way."""
+        must never crash the run in an unplanned way. Registered
+        observers (`add_observer` — the live monitor's flight
+        recorder) see the record too, BEFORE the stamp hits disk: a
+        kill fault's flight dump must happen while the process still
+        exists."""
         rec = {"event": "fault", "kind": fault.kind,
                "fault_id": fault.id, "wall": round(time.time(), 3),
                **extra}
+        for fn in list(_observers):
+            try:
+                fn(rec)
+            except Exception:
+                pass
         if self.log_file is None:
             return
         try:
@@ -409,6 +418,25 @@ class FaultPlan:
 
 _PLAN: FaultPlan | None = None
 _ENV_CHECKED = False
+
+# fault-stamp observers (round 12): the live monitor registers its
+# `note_line` here so an injected fault reaches the flight recorder
+# IN-PROCESS, before the process the fault may be about to kill is
+# gone — the JSONL tail alone would only serve post-mortem tailers.
+_observers: list = []
+
+
+def add_observer(fn) -> None:
+    """Register a callable(record_dict) invoked at every fault stamp."""
+    if fn not in _observers:
+        _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    try:
+        _observers.remove(fn)
+    except ValueError:
+        pass
 
 
 def configure(plan: FaultPlan | None) -> FaultPlan | None:

@@ -208,12 +208,21 @@ class Supervisor:
                  poll_interval: float = 1.0,
                  log=print, ledger_file: str | None = None,
                  term_grace: float = 5.0,
-                 child_env: dict | None = None):
+                 child_env: dict | None = None,
+                 monitor_port: int | None = None, slo: str = ""):
         self.argv = list(argv)
         self.policy = policy or RestartPolicy()
         self.hang_timeout = hang_timeout
         self.poll_interval = poll_interval
         self.log = log
+        # live aggregation (round 12): with a monitor port, the
+        # supervisor tails the child's metrics JSONL (which spans
+        # every restart stanza — including our own restart_downtime
+        # stamps) into a telemetry/monitor.Monitor and serves
+        # /status.json + /metrics for the WHOLE supervised history,
+        # surviving the children that produced it
+        self.monitor_port = monitor_port
+        self.slo = slo or ""
         # kill path (round 10): SIGTERM with a grace window before
         # SIGKILL, so the child's handler can flush its metrics-JSONL
         # tail (the goodput ledger the reducer reads) — a bare
@@ -336,12 +345,41 @@ class Supervisor:
             except OSError:
                 pass
 
+    def _start_monitor(self):
+        """(monitor, server, tailer) for --monitor-port, or Nones.
+        The tailer feeds the whole ledger file from byte 0 — a
+        supervisor attached mid-run aggregates the stanzas already on
+        disk, then follows."""
+        if self.monitor_port is None or not self.ledger_file:
+            if self.monitor_port is not None:
+                self.log("[elastic] --monitor-port needs the child "
+                         "command to carry --log-file (the metrics "
+                         "JSONL to aggregate); monitoring disabled")
+            return None, None, None
+        from shallowspeed_tpu.telemetry.monitor import (FileTailer,
+                                                        Monitor,
+                                                        StatusServer)
+
+        mon = Monitor(slos=self.slo, flight=0, derive_steps=True,
+                      snapshot_every=0)
+        srv = StatusServer(mon, port=self.monitor_port)
+        tailer = FileTailer(self.ledger_file, mon)
+        tailer.start()
+        self.log(f"[elastic] monitor: {srv.url('/status.json')} "
+                 f"(+ /metrics) over {self.ledger_file}")
+        return mon, srv, tailer
+
     def run(self) -> int:
         """Supervise until the child exits 0 or the restart budget is
         exhausted; returns the final exit code."""
+        mon, srv, tailer = self._start_monitor()
         try:
             return self._supervise()
         finally:
+            if tailer is not None:
+                tailer.stop()
+            if srv is not None:
+                srv.close()
             self._cleanup_heartbeats()
 
     def _last_logged_step(self) -> int | None:
@@ -513,7 +551,8 @@ class GangSupervisor(Supervisor):
                  poll_interval: float = 1.0, log=print,
                  ledger_file: str | None = None,
                  term_grace: float = 5.0,
-                 child_env: dict | None = None):
+                 child_env: dict | None = None,
+                 monitor_port: int | None = None, slo: str = ""):
         # deliberately NOT calling super().__init__: the heartbeat is
         # per-child here (N files, injected per process)
         self.argv = list(argv)
@@ -526,6 +565,11 @@ class GangSupervisor(Supervisor):
         self.log = log
         self.term_grace = term_grace
         self.child_env = dict(child_env or {})
+        # gang monitoring aggregates process 0's metrics file (the
+        # gang note below: a SHARED --log-file would interleave N
+        # stanzas; per-member files are per-member monitors)
+        self.monitor_port = monitor_port
+        self.slo = slo or ""
         self.heartbeat_file = None  # per-member files; see below
         self._poison_step = None
         self._poison_count = 0
@@ -690,6 +734,14 @@ def main(argv=None) -> int:
     ap.add_argument("--coordinator", default=None,
                     help="pin the gang's coordinator address "
                          "(default: a fresh localhost port per attempt)")
+    ap.add_argument("--monitor-port", type=int, default=None,
+                    help="serve /status.json + /metrics for the whole "
+                         "supervised history (tails the child's "
+                         "--log-file across restarts; 0 = free port)")
+    ap.add_argument("--slo", default="",
+                    help="SLOs evaluated over the aggregated stream "
+                         "(telemetry/monitor DSL, e.g. "
+                         "'ttft_p95_ms<500,availability>0.99')")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- training command")
     args = ap.parse_args(argv)
@@ -724,11 +776,15 @@ def main(argv=None) -> int:
                              hang_timeout=args.hang_timeout,
                              coordinator=args.coordinator,
                              term_grace=args.term_grace,
-                             child_env=child_env)
+                             child_env=child_env,
+                             monitor_port=args.monitor_port,
+                             slo=args.slo)
     else:
         sup = Supervisor(cmd, policy, hang_timeout=args.hang_timeout,
                          term_grace=args.term_grace,
-                         child_env=child_env)
+                         child_env=child_env,
+                         monitor_port=args.monitor_port,
+                         slo=args.slo)
     return sup.run()
 
 

@@ -19,10 +19,19 @@ from pathlib import Path
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics writer; no-op when path is falsy."""
+    """Append-only JSONL metrics writer; no-op when path is falsy.
 
-    def __init__(self, path=None, **run_info):
+    With a `telemetry.monitor.Monitor` attached (the drivers set
+    `.monitor` when any of --monitor-port / --slo / --flight-recorder
+    is on), every logged line is ALSO fed to `Monitor.note_line` —
+    the live plane ingests exactly the records the file gets, so the
+    /status.json view and the offline reducers read one stream. The
+    monitor feed runs even when `path` is falsy (an in-process engine
+    can be monitored without a log file)."""
+
+    def __init__(self, path=None, monitor=None, **run_info):
         self.path = Path(path) if path else None
+        self.monitor = monitor
         self._t0 = time.time()
         if self.path:
             from shallowspeed_tpu.telemetry.schema import SCHEMA_VERSION
@@ -32,7 +41,7 @@ class MetricsLogger:
                      **run_info)
 
     def log(self, **fields) -> None:
-        if not self.path:
+        if not self.path and self.monitor is None:
             return
         now = time.time()
         fields.setdefault("t", round(now - self._t0, 3))
@@ -40,8 +49,11 @@ class MetricsLogger:
         # reducer can account wall clock ACROSS supervisor restarts
         # (each process's `t` restarts at its own run_start)
         fields.setdefault("wall", round(now, 3))
-        with self.path.open("a") as f:
-            f.write(json.dumps(fields) + "\n")
+        if self.path:
+            with self.path.open("a") as f:
+                f.write(json.dumps(fields) + "\n")
+        if self.monitor is not None:
+            self.monitor.note_line(fields)
 
     def epoch(self, epoch: int, accuracy_start: float, samples: int,
               epoch_seconds: float) -> None:
@@ -76,7 +88,8 @@ class StepRates:
     """
 
     def __init__(self, tokens_per_step: float, clock=time.time,
-                 telemetry=None, health=None, ledger=None):
+                 telemetry=None, health=None, ledger=None,
+                 monitor=None):
         self.tokens_per_step = float(tokens_per_step)
         self._clock = clock
         self._t0 = clock()
@@ -103,6 +116,12 @@ class StepRates:
         # as in-window ledger counts
         self.ledger = ledger
         self._led_prev = {"recompiles": 0, "health_skipped_total": 0}
+        # optional telemetry.monitor.Monitor: every closed window
+        # feeds the live streaming sketches with the EXACT
+        # pause-excluded per-step time and window tok/s (the tailer's
+        # step-line derivation cannot exclude pauses; this path can —
+        # the monitor's derive_steps stays False when this is wired)
+        self.monitor = monitor
 
     def pause(self, seconds: float, kind: str = "pause") -> None:
         """Exclude `seconds` of non-training wall time (val eval, ckpt
@@ -129,6 +148,13 @@ class StepRates:
         cum = self.tokens_per_step * self._steps / cum_secs
         self._win_t, self._win_pause = now, self._pause
         out = {"tokens_per_sec": win, "tokens_per_sec_cum": cum}
+        if self.monitor is not None and steps_since_last > 0:
+            # the window's mean per-step time, weighted by its step
+            # count — the sketch sees every step at the window average
+            self.monitor.observe(
+                "step_ms", win_secs * 1e3 / steps_since_last,
+                count=int(steps_since_last))
+            self.monitor.observe("tok_s", win)
         if self.health is not None:
             out.update(self.health.step_fields())
         if self.telemetry is not None:

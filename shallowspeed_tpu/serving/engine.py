@@ -271,7 +271,19 @@ class ServingEngine:
         self.results: dict[str, np.ndarray] = {}
         self.request_records: list[dict] = []
         self.counters = {"submitted": 0, "finished": 0, "preempted": 0,
-                         "ticks": 0, "prefill_chunks": 0}
+                         "ticks": 0, "prefill_chunks": 0,
+                         "shed_toggles": 0}
+        # SLO load shedding (round 12, telemetry/monitor): while
+        # `admission_paused`, `_admit` leaves the queue alone — running
+        # requests keep every slot/block they hold and drain the
+        # latency backlog; queued requests wait (submit() still
+        # accepts). `on_alert` is the monitor-facing hook that pauses
+        # while ANY SLO rule's critical burn persists (tracked per
+        # rule — one rule resolving must not release another rule's
+        # shed) — OFF by default: serve.py wires it only under
+        # --shed-load, so the alert plane is telemetry-only otherwise.
+        self.admission_paused = False
+        self._critical_slos: set[str] = set()
         self._admit_counter = 0
         self._win_tokens = 0            # tokens since the last log line
         self._win_t = clock()
@@ -367,8 +379,41 @@ class ServingEngine:
         yield from (s for s in self.slots if s is not None)
         yield from self.queue
 
+    def on_alert(self, alert: dict) -> None:
+        """SLO burn-rate alert hook (`Monitor.alert_listeners`): pause
+        admission while ANY rule's critical burn persists, resume when
+        the LAST critical rule resolves or de-escalates. Alerts are
+        per-rule state transitions, so membership is tracked per SLO
+        spec — rule B resolving while rule A still burns critical must
+        not release A's shed. Stamps a ledger-style `"ledger"` line
+        (kind `load_shed`) at each pause/resume toggle so the goodput
+        reducer can see the shed windows next to the request records."""
+        slo = str(alert.get("slo"))
+        if (alert.get("state") == "firing"
+                and alert.get("severity") == "critical"):
+            self._critical_slos.add(slo)
+        else:
+            self._critical_slos.discard(slo)
+        want = bool(self._critical_slos)
+        if want == self.admission_paused:
+            return
+        self.admission_paused = want
+        self.counters["shed_toggles"] += 1
+        if self.metrics is not None:
+            self.metrics.log(event="ledger", kind="load_shed",
+                             count=1 if want else 0,
+                             slo=sorted(self._critical_slos)[0]
+                             if want else slo)
+
     def _admit(self) -> bool:
         did = False
+        if self.admission_paused and any(s is not None
+                                         for s in self.slots):
+            # shed: drain the in-flight work, admit nothing new. The
+            # all-slots-empty carve-out keeps the scheduler live — a
+            # pause with nothing running would wedge `run()` (no
+            # progress, requests pending) without shedding any load.
+            return False
         while self.queue and None in self.slots:
             req = self.queue[0]
             need = blocks_for(len(req.ctx), self.block_size)

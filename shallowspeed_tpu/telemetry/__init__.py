@@ -29,9 +29,17 @@ makes the *inside* of a step visible without xprof:
 - `anomaly`      streaming detectors over the health series: robust
                  EWMA z-scores (loss/grad spikes), divergence,
                  dead-layer; verdict -> action policy.
+- `sketch`       mergeable log-bucketed histogram sketches — streaming
+                 p50/p95/p99 in constant memory, serialized as
+                 schema-v7 "monitor" events, merged across processes.
+- `monitor`      the live telemetry plane (round 12): /status.json +
+                 /metrics endpoints (--monitor-port), SLO burn-rate
+                 alerts (--slo), anomaly flight recorder
+                 (--flight-recorder), and the --live JSONL tailer.
 - `python -m shallowspeed_tpu.telemetry --validate f.jsonl ...`
                  schema gate for committed `docs_runs/*.jsonl` traces
-                 (pre-commit hook).
+                 (pre-commit hook); `--live f.jsonl [--once]` renders
+                 the live status view of a growing metrics file.
 
 Levels: `off` (no-ops — no fences, no buffers), `steps` (host
 timestamps only; the async dispatch pipeline is preserved), `spans`
@@ -63,6 +71,12 @@ _LAZY = {
     "device_rates": "attribution",
     "GoodputLedger": "goodput", "run_goodput": "goodput",
     "check_trajectory": "regress", "load_trajectory": "regress",
+    # live telemetry plane (round 12): streaming sketches, /status +
+    # /metrics endpoints, SLO burn-rate alerts, flight recorder
+    "LogHistogram": "sketch", "MetricSketches": "sketch",
+    "Monitor": "monitor", "StatusServer": "monitor",
+    "FlightRecorder": "monitor", "SloRule": "monitor",
+    "parse_slos": "monitor", "FileTailer": "monitor",
 }
 
 

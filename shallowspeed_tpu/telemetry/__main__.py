@@ -1,18 +1,25 @@
-"""CLI: validate committed JSONL, gate the bench trajectory, or reduce
-a run's goodput ledger.
+"""CLI: validate committed JSONL, gate the bench trajectory, reduce a
+run's goodput ledger, or watch a run live.
 
     python -m shallowspeed_tpu.telemetry --validate docs_runs/*.jsonl
     python -m shallowspeed_tpu.telemetry --validate docs_runs/
     python -m shallowspeed_tpu.telemetry --regress BENCH_*.json
     python -m shallowspeed_tpu.telemetry --regress .
     python -m shallowspeed_tpu.telemetry --goodput run/metrics.jsonl
+    python -m shallowspeed_tpu.telemetry --live run/metrics.jsonl
+    python -m shallowspeed_tpu.telemetry --live f.jsonl --once
 
 --validate and --regress are the pre-commit gates for committed
 `docs_runs/*.jsonl` snapshots and the `BENCH_r*.json` trajectory —
 both pure-stdlib checks that cost only the package import (~1 s), not
 a trace or a bench run of anything. --goodput prints the run-level
 wall-clock decomposition (goodput + named losses) of one metrics
-JSONL, including runs that span supervisor restarts.
+JSONL, including runs that span supervisor restarts. --live tails a
+GROWING metrics JSONL and renders the same view the --monitor-port
+/status.json endpoint serves (streaming sketch quantiles, goodput so
+far, health, SLO burn rates with --slo) — live monitoring for runs
+started without an endpoint; --once renders the current state and
+exits (the pre-commit smoke mode).
 """
 
 from __future__ import annotations
@@ -40,7 +47,26 @@ def main(argv=None) -> int:
                         "injected-fault tally on chaos drills, and "
                         "p50/p95 ttft/tpot on serving runs with "
                         "schema-v6 request events)")
+    g.add_argument("--live", metavar="JSONL",
+                   help="tail a growing metrics JSONL and render the "
+                        "live status view (the /status.json surface "
+                        "for endpoint-less runs); Ctrl-C exits")
+    p.add_argument("--once", action="store_true",
+                   help="with --live: render the file's current state "
+                        "once and exit instead of following")
+    p.add_argument("--slo", default="",
+                   help="with --live: evaluate these SLOs over the "
+                        "tailed stream (telemetry/monitor DSL, e.g. "
+                        "'ttft_p95_ms<500,availability>0.99')")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="with --live: seconds between renders")
     args = p.parse_args(argv)
+
+    if args.live:
+        from shallowspeed_tpu.telemetry.monitor import live_main
+
+        return live_main(args.live, slos=args.slo, once=args.once,
+                         interval=args.interval)
 
     if args.regress:
         from shallowspeed_tpu.telemetry.regress import main as rmain

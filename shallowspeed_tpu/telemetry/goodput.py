@@ -319,6 +319,11 @@ def run_goodput(path) -> dict:
         # None for training runs (no request events) — the serving
         # block appears only when the JSONL carries schema-v6 stamps
         "requests": _request_block(request_recs),
+        # None without schema-v7 monitor snapshots — the merged
+        # streaming-sketch quantiles, cross-checked against the exact
+        # offline percentiles above (same rank rule; they may differ
+        # only by the sketch's recorded rel_err)
+        "monitor": _monitor_block(stanzas, request_recs),
     }
 
 
@@ -326,6 +331,70 @@ def _request_block(request_recs) -> dict | None:
     from shallowspeed_tpu.telemetry.report import request_summary
 
     return request_summary(request_recs)
+
+
+def _monitor_block(stanzas, request_recs) -> dict | None:
+    """Merge each stanza's LAST schema-v7 ``"monitor"`` snapshot (a
+    process's sketches are cumulative, so its last snapshot is its
+    total; summing the last per stanza is the whole run) and
+    cross-check the merged sketch quantiles against the exact offline
+    request percentiles. `within_bound` uses the sketch's own recorded
+    rel_err — the live/offline parity contract the acceptance pins."""
+    from shallowspeed_tpu.telemetry.report import percentile
+    from shallowspeed_tpu.telemetry.sketch import MetricSketches
+
+    last_snaps = []
+    for st in stanzas:
+        snaps = [r for r in st["lines"] if r.get("event") == "monitor"
+                 and isinstance(r.get("sketches"), dict)]
+        if snaps:
+            last_snaps.append(snaps[-1])
+    if not last_snaps:
+        return None
+    # bucket indices are only comparable on ONE gamma grid
+    # (LogHistogram.merge raises on a rel_err mismatch) — snapshots
+    # from mixed-precision producers (two builds/configs in one
+    # supervised history) reduce to the LARGEST same-rel_err group
+    # and the report says how many were left out, instead of the
+    # reducer crashing on a schema-valid file
+    by_err: dict[float, list] = {}
+    for s in last_snaps:
+        by_err.setdefault(float(s.get("rel_err", 0.01)), []).append(s)
+    rel_err, group = max(by_err.items(), key=lambda kv: len(kv[1]))
+    merged = MetricSketches(rel_err=rel_err)
+    n_merged = 0
+    for snap in group:
+        try:
+            merged.merge_dict(snap["sketches"])
+            n_merged += 1
+        except (ValueError, TypeError):
+            # a hand-edited snapshot whose per-sketch rel_err
+            # disagrees with its own header; skip it, keep reducing
+            continue
+    if not n_merged:
+        return None
+    out = {"snapshots": n_merged, "rel_err": rel_err,
+           "quantiles": merged.summary()}
+    if n_merged < len(last_snaps):
+        out["skipped_mixed_rel_err"] = len(last_snaps) - n_merged
+    parity = {}
+    for name in ("ttft_ms", "tpot_ms"):
+        exact_vals = [r[name] for r in request_recs
+                      if isinstance(r.get(name), (int, float))]
+        sk = merged.sketches.get(name)
+        if not exact_vals or sk is None or not sk.n:
+            continue
+        for q in (50, 95):
+            exact = percentile(exact_vals, q)
+            live = sk.quantile(q)
+            parity[f"{name}_p{q}"] = {
+                "sketch": round(live, 3), "exact": round(exact, 3),
+                "within_bound": abs(live - exact)
+                <= rel_err * abs(exact) + 1e-9,
+            }
+    if parity:
+        out["parity"] = parity
+    return out
 
 
 def format_report(rep: dict) -> str:
@@ -365,6 +434,20 @@ def format_report(rep: dict) -> str:
             f"{ms(req['tpot_ms_p95'])} ms  "
             f"tokens {req['tokens_in']}->{req['tokens_out']}  "
             f"preempted {req['preempted']}")
+    mon = rep.get("monitor")
+    if mon:
+        qs = mon["quantiles"]
+        parts = [f"{name} p50/p95 {sk.get('p50')}/{sk.get('p95')}"
+                 for name, sk in qs.items()
+                 if name in ("step_ms", "ttft_ms", "tpot_ms")]
+        lines.append(f"monitor sketches ({mon['snapshots']} snapshot(s)"
+                     f", rel_err {mon['rel_err']}): "
+                     + "  ".join(parts))
+        bad = [k for k, v in mon.get("parity", {}).items()
+               if not v["within_bound"]]
+        if bad:
+            lines.append(f"  WARNING: sketch/offline parity out of "
+                         f"bound: {bad}")
     if rep.get("availability") is not None:
         lines.append(f"availability {rep['availability']:.2%}")
     lines.append(f"accounted {rep['accounted_frac'] if rep['accounted_frac'] is not None else '—'}"

@@ -1,0 +1,863 @@
+"""Live telemetry plane — what the run looks like *while it runs*.
+
+Everything before this module reduced a FINISHED metrics JSONL
+(`--goodput`, `report.request_summary`); an operator watching a
+serving fleet, the elastic supervisor deciding whether a child is
+healthy, or an MPMD-era controller rebalancing stages needs the same
+answers while the process is alive. Four parts:
+
+- **Streaming aggregation** (`sketch.LogHistogram`): p50/p95/p99 over
+  step time, ttft/tpot, tok/s, queue depth and free blocks in constant
+  memory, fed from `metrics.StepRates` (exact pause-excluded window
+  rates) and the schema-v6 ``"request"``/``"generate"`` lines. The
+  sketches serialize into the JSONL as periodic schema-v7
+  ``"monitor"`` snapshot events and MERGE across processes/stanzas —
+  `--goodput` cross-checks the merged sketch quantiles against its
+  exact offline percentiles (same nearest-rank rule, so they agree to
+  the sketch's documented rel_err).
+- **Live endpoints** (`StatusServer`): a stdlib ``http.server`` behind
+  ``--monitor-port`` on the drivers and the elastic supervisor —
+  ``/status.json`` (quantiles, goodput-so-far, health verdict,
+  queue/alloc state, last fault, active alerts) and ``/metrics`` in
+  Prometheus text exposition format. ``python -m
+  shallowspeed_tpu.telemetry --live f.jsonl`` tails a growing file and
+  renders the same view for endpoint-less runs.
+- **SLO burn-rate alerts** (`parse_slos` + the per-rule dual-window
+  evaluator): declarative SLOs (``--slo
+  'ttft_p95_ms<500,availability>0.99'``) evaluated over a fast and a
+  slow window; an alert fires only when BOTH windows burn error
+  budget faster than the threshold (the multiwindow rule that kills
+  both flavors of false page: a blip trips the fast window but not
+  the slow, a slow bleed trips the slow but resolved blips keep the
+  fast window clean). Alerts land as schema-v7 ``"alert"`` events and
+  reach `ServingEngine.on_alert` (load shedding, opt-in).
+- **Anomaly flight recorder** (`FlightRecorder`): a ring of the last N
+  full-resolution lines (step/tick/request/ledger + tracer spans)
+  dumped to ``flightrec_<step>.json`` when an anomaly verdict fires, a
+  chaos fault stamps, or an SLO alert trips — the forensics AROUND the
+  incident, not a summary after it.
+
+One ingestion path: `Monitor.note_line(rec)` accepts exactly the dicts
+`metrics.MetricsLogger` writes, so the in-process wiring (the logger
+forwards every line), the `--live` tailer, and the supervisor's
+aggregation (tailing the child's ledger file across restarts) are the
+same code — live and offline can only disagree by the sketch error.
+
+Heavier deps (jax) never load here: pure stdlib, like `sketch`.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+from shallowspeed_tpu.telemetry.sketch import LogHistogram, MetricSketches
+
+# sketch names the monitor maintains; anything can be observed, these
+# are the documented core set
+CORE_SKETCHES = ("step_ms", "ttft_ms", "tpot_ms", "tok_s",
+                 "queue_depth", "free_blocks")
+
+
+# --------------------------------------------------------------- SLOs
+
+
+_SLO_RE = re.compile(r"^\s*([a-zA-Z0-9_]+)\s*([<>])\s*"
+                     r"([0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)\s*$")
+_QUANT_RE = re.compile(r"^(.*?)_p([0-9]{1,2})(_[a-z0-9]+)?$")
+
+
+class SloRule:
+    """One declarative SLO plus its dual-window burn-rate state.
+
+    Two shapes:
+
+    - quantile rule (``ttft_p95_ms<500``): every observation of the
+      underlying sketch (here ``ttft_ms``) is good iff it satisfies
+      the threshold; the error budget is the quantile's complement
+      (p95 -> 5% of observations may be bad). Burn rate over a window
+      = bad_fraction / budget — burn 1.0 exactly spends the budget,
+      burn 10 exhausts it 10x too fast.
+    - scalar rule (``availability>0.99``): fed downtime seconds
+      (supervisor restart stamps); burn = downtime_in_window /
+      (window * (1 - target)).
+
+    An alert fires when BOTH the fast and the slow window exceed the
+    burn threshold, at ``critical`` when both exceed the critical
+    threshold; it resolves when either window recovers.
+    """
+
+    def __init__(self, spec: str, fast_s: float = 60.0,
+                 slow_s: float = 600.0, warn_burn: float = 2.0,
+                 critical_burn: float = 10.0, min_count: int = 5):
+        m = _SLO_RE.match(spec)
+        if not m:
+            raise ValueError(
+                f"bad SLO {spec!r}: want 'metric<value' or "
+                f"'metric>value' (e.g. ttft_p95_ms<500, "
+                f"availability>0.99)")
+        self.spec = spec.strip()
+        self.metric, self.op = m.group(1), m.group(2)
+        self.threshold = float(m.group(3))
+        self.fast_s, self.slow_s = float(fast_s), float(slow_s)
+        self.warn_burn, self.critical_burn = (float(warn_burn),
+                                              float(critical_burn))
+        self.min_count = int(min_count)
+        qm = _QUANT_RE.match(self.metric)
+        if self.metric == "availability":
+            self.sketch = None
+            self.q = None
+            if self.op != ">" or not 0.0 < self.threshold < 1.0:
+                raise ValueError(f"bad SLO {spec!r}: availability "
+                                 f"takes '>frac' with frac in (0, 1)")
+            self.budget = 1.0 - self.threshold
+        elif qm:
+            self.sketch = qm.group(1) + (qm.group(3) or "")
+            self.q = int(qm.group(2))
+            if not 0 < self.q < 100:
+                raise ValueError(f"bad SLO {spec!r}: quantile must be "
+                                 f"in (0, 100)")
+            self.budget = max(1.0 - self.q / 100.0, 1e-6)
+        else:
+            raise ValueError(
+                f"bad SLO {spec!r}: metric must be 'availability' or "
+                f"'<sketch>_pNN[_unit]' over one of the monitor "
+                f"sketches (e.g. {', '.join(CORE_SKETCHES)})")
+        # (t, bad_count, total_count) for quantile rules;
+        # (t, down_seconds, 0) for the availability rule
+        self._events: deque = deque()
+        self.state: str | None = None      # None | "warn" | "critical"
+        self.last_value: float | None = None
+
+    # ------------------------------------------------------------ feed
+
+    def record(self, value: float, now: float, count: int = 1) -> None:
+        """One observation of this rule's underlying sketch metric."""
+        good = (value < self.threshold if self.op == "<"
+                else value > self.threshold)
+        self.last_value = float(value)
+        self._events.append((now, 0 if good else count, count))
+        self._prune(now)
+
+    def record_down(self, seconds: float, now: float) -> None:
+        """Availability rule: `seconds` of downtime ending at `now`."""
+        self._events.append((now, float(seconds), 0))
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.slow_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    # ------------------------------------------------------- evaluate
+
+    def burn(self, window_s: float, now: float) -> float:
+        lo = now - window_s
+        if self.sketch is None:
+            down = sum(b for t, b, _ in self._events if t > lo)
+            return down / (window_s * self.budget)
+        bad = tot = 0
+        for t, b, c in self._events:
+            if t > lo:
+                bad += b
+                tot += c
+        if tot < self.min_count:
+            return 0.0
+        return (bad / tot) / self.budget
+
+    def evaluate(self, now: float) -> dict | None:
+        """Returns an alert record when the state CHANGES (fire,
+        escalate, resolve), else None."""
+        self._prune(now)
+        bf = self.burn(self.fast_s, now)
+        bs = self.burn(self.slow_s, now)
+        sev = ("critical" if min(bf, bs) >= self.critical_burn
+               else "warn" if min(bf, bs) >= self.warn_burn else None)
+        if sev == self.state:
+            return None
+        prev, self.state = self.state, sev
+        rec = {"slo": self.spec, "metric": self.metric,
+               "state": "firing" if sev else "resolved",
+               "severity": sev or prev,
+               "burn_fast": round(bf, 3), "burn_slow": round(bs, 3),
+               "threshold": self.threshold}
+        if self.last_value is not None:
+            rec["value"] = round(self.last_value, 6)
+        return rec
+
+    def status(self, now: float) -> dict:
+        return {"slo": self.spec,
+                "state": self.state or "ok",
+                "burn_fast": round(self.burn(self.fast_s, now), 3),
+                "burn_slow": round(self.burn(self.slow_s, now), 3)}
+
+
+def parse_slos(spec: str, **kw) -> list[SloRule]:
+    """``--slo 'ttft_p95_ms<500,availability>0.99'`` -> rules.
+    A typed ValueError on the first bad token (fail at arg time, not
+    mid-run)."""
+    if not spec or not spec.strip():
+        return []
+    return [SloRule(tok, **kw) for tok in spec.split(",") if tok.strip()]
+
+
+# ---------------------------------------------------- flight recorder
+
+
+class FlightRecorder:
+    """Ring buffer of the last `capacity` full-resolution records
+    (metrics lines + tracer span events), dumped on incident triggers.
+
+    Dumps are deduplicated by (reason, step) and capped per run —
+    an alert flapping at log-point cadence must not fill the disk
+    with identical snapshots.
+    """
+
+    def __init__(self, capacity: int = 256, out_dir=None,
+                 max_dumps: int = 16):
+        self.ring: deque = deque(maxlen=max(1, int(capacity)))
+        self.out_dir = Path(out_dir) if out_dir else Path(".")
+        self.max_dumps = int(max_dumps)
+        self.dumps: list[str] = []
+        self._seen: set = set()
+
+    def record(self, rec: dict) -> None:
+        self.ring.append(rec)
+
+    def dump(self, reason: str, step=None, trigger=None) -> str | None:
+        key = (reason, step)
+        if key in self._seen or len(self.dumps) >= self.max_dumps:
+            return None
+        self._seen.add(key)
+        tag = step if step is not None else f"n{len(self.dumps)}"
+        path = self.out_dir / f"flightrec_{tag}.json"
+        k = 0
+        while path.exists():
+            k += 1
+            path = self.out_dir / f"flightrec_{tag}_{k}.json"
+        payload = {"reason": reason, "step": step,
+                   "wall": round(time.time(), 3), "trigger": trigger,
+                   "n_entries": len(self.ring),
+                   "ring": list(self.ring)}
+        try:
+            self.out_dir.mkdir(parents=True, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=1, default=str)
+        except OSError:
+            return None
+        self.dumps.append(str(path))
+        return str(path)
+
+
+# ------------------------------------------------------------ monitor
+
+
+class Monitor:
+    """The live telemetry plane for one process (module docstring).
+
+    `note_line(rec)` is the single ingestion path; `emit` (usually the
+    bound `MetricsLogger.log`) receives the periodic ``"monitor"``
+    snapshots and ``"alert"`` events this monitor produces;
+    `alert_listeners` (e.g. `ServingEngine.on_alert`) get every alert
+    record as a dict.
+
+    `derive_steps=True` (the tailer / supervisor mode) reconstructs
+    per-step time and tok/s from consecutive ``"step"`` lines; the
+    in-process drivers leave it False and feed exact pause-excluded
+    window rates through `StepRates(monitor=...)` instead — wiring
+    both would double-count.
+    """
+
+    def __init__(self, slos: str = "", flight: int = 256,
+                 flight_dir=None, rel_err: float = 0.01, emit=None,
+                 derive_steps: bool = False, snapshot_every: int = 64,
+                 clock=time.time, slo_kw: dict | None = None):
+        self.sketches = MetricSketches(rel_err=rel_err)
+        self.rules = parse_slos(slos, **(slo_kw or {}))
+        self.flight = FlightRecorder(capacity=flight or 256,
+                                     out_dir=flight_dir)
+        self.flight_enabled = flight > 0
+        self.emit = emit
+        self.derive_steps = bool(derive_steps)
+        self.snapshot_every = int(snapshot_every)
+        self.clock = clock
+        self.alert_listeners: list = []
+        self.counters = {"lines": 0, "steps": 0, "requests": 0,
+                         "faults": 0, "alerts": 0, "restarts": 0,
+                         "snapshots": 0, "flight_dumps": 0}
+        self.health = "ok"
+        self.last_fault: dict | None = None
+        self.last_step: dict | None = None
+        self.serving: dict = {}
+        self.active_alerts: dict[str, dict] = {}
+        self._first_wall: float | None = None
+        self._last_wall: float | None = None
+        self._loss_s = 0.0            # ledgered non-productive seconds
+        self._downtime_s = 0.0
+        self._prev_step: tuple | None = None   # (step, wall)
+        self._lines_since_snap = 0
+        self._emitting = False
+        self._lock = threading.RLock()
+
+    # -------------------------------------------------------- ingest
+
+    def observe(self, name: str, value, count: int = 1) -> None:
+        """Direct sketch feed (exact values — `StepRates` uses this
+        for pause-excluded step_ms/tok_s); also feeds any SLO rule
+        bound to that sketch."""
+        with self._lock:
+            self.sketches.observe(name, value, count)
+            now = self._now()
+            for rule in self.rules:
+                if rule.sketch == name:
+                    rule.record(float(value), now, count)
+            self._evaluate(now)
+
+    def note_line(self, rec: dict) -> None:
+        """Ingest one metrics-JSONL record (exactly the dict
+        `MetricsLogger` writes / the tailer parses)."""
+        if not isinstance(rec, dict):
+            return
+        if self._emitting:
+            return      # our own monitor/alert emission re-entering
+        ev = rec.get("event")
+        if ev == "monitor":
+            return      # derived data; merging it back would double-count
+        with self._lock:
+            self.counters["lines"] += 1
+            wall = rec.get("wall")
+            if isinstance(wall, (int, float)):
+                if self._first_wall is None:
+                    self._first_wall = float(wall)
+                self._last_wall = max(self._last_wall or 0.0,
+                                      float(wall))
+            if ev is not None and self.flight_enabled:
+                self.flight.record(rec)
+            handler = getattr(self, f"_on_{ev}", None) \
+                if isinstance(ev, str) else None
+            if handler is not None:
+                handler(rec)
+            self._lines_since_snap += 1
+            if self.snapshot_every and \
+                    self._lines_since_snap >= self.snapshot_every:
+                self._snapshot_locked()
+            self._evaluate(self._now())
+
+    def record_span(self, ev: dict) -> None:
+        """Tracer subscriber: span events join the flight ring (full
+        resolution around an incident includes the phase spans)."""
+        if self.flight_enabled:
+            with self._lock:
+                self.flight.record(ev)
+
+    # per-event handlers (note_line dispatch) ------------------------
+
+    def _on_step(self, rec: dict) -> None:
+        self.counters["steps"] += 1
+        self.last_step = {k: rec.get(k) for k in
+                          ("step", "loss", "tokens_per_sec", "mfu",
+                           "wall") if k in rec}
+        verdicts = rec.get("health_verdicts")
+        if verdicts:
+            self.health = "warn: " + ",".join(str(v) for v in verdicts)
+            self._flight_dump("anomaly:" + ",".join(
+                str(v) for v in verdicts), rec.get("step"), rec)
+        elif rec.get("health_nonfinite"):
+            self.health = "warn: nonfinite"
+        if self.derive_steps:
+            step, wall = rec.get("step"), rec.get("wall")
+            if isinstance(rec.get("tokens_per_sec"), (int, float)):
+                self.observe_locked("tok_s", rec["tokens_per_sec"])
+            if isinstance(step, int) and isinstance(wall, (int, float)):
+                if self._prev_step is not None:
+                    s0, w0 = self._prev_step
+                    if step > s0 and wall > w0:
+                        # approximate (pauses between log points are
+                        # not excluded here; the in-process StepRates
+                        # feed is the exact one)
+                        ms = (wall - w0) * 1e3 / (step - s0)
+                        self.observe_locked("step_ms", ms,
+                                            count=step - s0)
+                self._prev_step = (step, float(wall))
+
+    def _on_request(self, rec: dict) -> None:
+        self.counters["requests"] += 1
+        now = self._now()
+        for field, name in (("ttft_ms", "ttft_ms"),
+                            ("tpot_ms", "tpot_ms")):
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                self.sketches.observe(name, v)
+                for rule in self.rules:
+                    if rule.sketch == name:
+                        rule.record(float(v), now)
+        if isinstance(rec.get("queue_depth"), int):
+            self.serving["queue_depth"] = rec["queue_depth"]
+
+    def _on_generate(self, rec: dict) -> None:
+        for field, name in (("tokens_per_sec", "tok_s"),
+                            ("queue_depth", "queue_depth"),
+                            ("free_blocks", "free_blocks")):
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.sketches.observe(name, v)
+        now = self._now()
+        for field in ("queue_depth", "active_slots", "free_blocks",
+                      "blocks_touched", "hbm_gbps"):
+            if field in rec:
+                self.serving[field] = rec[field]
+        for rule in self.rules:
+            if rule.sketch in ("tok_s", "queue_depth", "free_blocks"):
+                v = rec.get({"tok_s": "tokens_per_sec"}.get(
+                    rule.sketch, rule.sketch))
+                if isinstance(v, (int, float)):
+                    rule.record(float(v), now)
+
+    def _on_ledger(self, rec: dict) -> None:
+        secs = rec.get("seconds")
+        if isinstance(secs, (int, float)):
+            self._loss_s += float(secs)
+            if rec.get("kind") == "restart_downtime":
+                self._downtime_s += float(secs)
+                self.counters["restarts"] += 1
+                now = self._now()
+                for rule in self.rules:
+                    if rule.sketch is None:
+                        rule.record_down(float(secs), now)
+
+    def _on_fault(self, rec: dict) -> None:
+        self.counters["faults"] += 1
+        self.last_fault = dict(rec)
+        self._flight_dump(f"fault:{rec.get('kind')}", rec.get("step"),
+                          rec)
+
+    def _on_health(self, rec: dict) -> None:
+        verdicts = rec.get("health_verdicts")
+        if verdicts:
+            self.health = "warn: " + ",".join(str(v) for v in verdicts)
+            self._flight_dump("anomaly:" + ",".join(
+                str(v) for v in verdicts), rec.get("step"), rec)
+
+    def _on_alert(self, rec: dict) -> None:
+        # alerts from ANOTHER process's monitor (tailer mode): surface
+        # them without re-evaluating
+        if rec.get("state") == "firing":
+            self.active_alerts[rec.get("slo", "?")] = dict(rec)
+        else:
+            self.active_alerts.pop(rec.get("slo", "?"), None)
+
+    # ------------------------------------------------------ internals
+
+    def observe_locked(self, name, value, count=1):
+        # observe() body without re-taking the RLock-guarded evaluate
+        # (RLock makes this safe either way; kept for symmetry)
+        self.sketches.observe(name, value, count)
+        now = self._now()
+        for rule in self.rules:
+            if rule.sketch == name:
+                rule.record(float(value), now, count)
+
+    def _now(self) -> float:
+        # event time: the last wall stamp seen keeps tailed history
+        # evaluating in ITS timeline; live processes stamp wall
+        # continuously so this is ~now there
+        return self._last_wall if self._last_wall is not None \
+            else self.clock()
+
+    def _evaluate(self, now: float) -> None:
+        for rule in self.rules:
+            rec = rule.evaluate(now)
+            if rec is None:
+                continue
+            self.counters["alerts"] += 1
+            rec["wall"] = round(now, 3)
+            if rec["state"] == "firing":
+                self.active_alerts[rule.spec] = rec
+                self._flight_dump(
+                    f"slo:{rule.spec}",
+                    (self.last_step or {}).get("step"), rec)
+            else:
+                self.active_alerts.pop(rule.spec, None)
+            self._emit_rec("alert", rec)
+            for fn in list(self.alert_listeners):
+                try:
+                    fn(rec)
+                except Exception:
+                    pass  # a broken listener must not kill the run
+
+    def flight_dump(self, reason: str, step=None, trigger=None) -> None:
+        """Public incident trigger — the drivers call this on their
+        labeled-abort paths (divergence exit, fatal anomaly verdict),
+        where the process dies before the next line would reach
+        `note_line`."""
+        with self._lock:
+            self._flight_dump(reason, step, trigger)
+
+    def _flight_dump(self, reason: str, step, trigger) -> None:
+        if not self.flight_enabled:
+            return
+        path = self.flight.dump(reason, step=step, trigger=trigger)
+        if path is not None:
+            self.counters["flight_dumps"] += 1
+
+    def _emit_rec(self, event: str, rec: dict) -> None:
+        if self.emit is None:
+            return
+        self._emitting = True
+        try:
+            self.emit(event=event, **{k: v for k, v in rec.items()
+                                      if k != "event"})
+        except Exception:
+            pass
+        finally:
+            self._emitting = False
+
+    # ------------------------------------------------------- snapshot
+
+    def _snapshot_locked(self) -> dict:
+        self._lines_since_snap = 0
+        self.counters["snapshots"] += 1
+        snap = {"sketches": self.sketches.to_dict(),
+                "counters": dict(self.counters),
+                "rel_err": self.sketches.rel_err}
+        self._emit_rec("monitor", snap)
+        return snap
+
+    def snapshot(self) -> dict:
+        """Serialize-and-emit the current sketch state (a schema-v7
+        ``"monitor"`` event payload); merge with `merge_snapshot`."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    def merge_snapshot(self, snap: dict) -> None:
+        """Fold another process's ``"monitor"`` payload into this one
+        (the fleet/gang aggregation path)."""
+        with self._lock:
+            self.sketches.merge_dict(snap.get("sketches") or {})
+
+    def close(self) -> None:
+        """Final snapshot so the JSONL tail carries the run's whole
+        distribution for offline merging."""
+        with self._lock:
+            if any(sk.n for sk in self.sketches.sketches.values()):
+                self._snapshot_locked()
+
+    # --------------------------------------------------------- views
+
+    def goodput_so_far(self) -> float | None:
+        """In-flight approximation: 1 - (ledgered losses + downtime) /
+        wall. The offline reducer additionally splits compile/replay
+        out of the productive share; this is the monotone headline an
+        operator watches, not the final accounting."""
+        if self._first_wall is None or self._last_wall is None:
+            return None
+        wall = self._last_wall - self._first_wall
+        if wall <= 0:
+            return None
+        return max(0.0, min(1.0, 1.0 - self._loss_s / wall))
+
+    def availability(self) -> float | None:
+        if self._first_wall is None or self._last_wall is None:
+            return None
+        wall = self._last_wall - self._first_wall
+        if wall <= 0:
+            return None
+        return max(0.0, 1.0 - min(self._downtime_s, wall) / wall)
+
+    def status(self) -> dict:
+        """The /status.json payload."""
+        with self._lock:
+            now = self._now()
+            return {
+                "wall": round(now, 3),
+                "uptime_s": (round(now - self._first_wall, 3)
+                             if self._first_wall is not None else None),
+                "sketches": self.sketches.summary(),
+                "rel_err": self.sketches.rel_err,
+                "goodput_so_far": self.goodput_so_far(),
+                "availability": self.availability(),
+                "health": self.health,
+                "last_step": self.last_step,
+                "serving": self.serving or None,
+                "last_fault": self.last_fault,
+                "slo": [r.status(now) for r in self.rules],
+                "alerts": sorted(self.active_alerts.values(),
+                                 key=lambda a: a.get("slo", "")),
+                "counters": dict(self.counters),
+                "flight_dumps": list(self.flight.dumps),
+            }
+
+    def prometheus(self) -> str:
+        """The /metrics payload (Prometheus text exposition 0.0.4)."""
+        with self._lock:
+            P = "shallowspeed_"
+            lines = [f"# TYPE {P}up gauge", f"{P}up 1"]
+            for name, sk in sorted(self.sketches.sketches.items()):
+                if not sk.n:
+                    continue
+                base = P + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+                lines.append(f"# TYPE {base} summary")
+                for q in (0.5, 0.95, 0.99):
+                    v = sk.quantile(q * 100)
+                    lines.append(f'{base}{{quantile="{q}"}} {v:.6g}')
+                lines.append(f"{base}_sum {sk.total:.6g}")
+                lines.append(f"{base}_count {sk.n}")
+            for name, v in (("goodput_so_far", self.goodput_so_far()),
+                            ("availability", self.availability())):
+                if v is not None:
+                    lines.append(f"# TYPE {P}{name} gauge")
+                    lines.append(f"{P}{name} {v:.6g}")
+            for field in ("queue_depth", "active_slots", "free_blocks"):
+                v = self.serving.get(field)
+                if isinstance(v, (int, float)):
+                    lines.append(f"# TYPE {P}{field} gauge")
+                    lines.append(f"{P}{field} {v:.6g}")
+            if self.last_step and isinstance(
+                    self.last_step.get("step"), int):
+                lines.append(f"# TYPE {P}last_step gauge")
+                lines.append(f"{P}last_step {self.last_step['step']}")
+            lines.append(f"# TYPE {P}alerts_firing gauge")
+            lines.append(f"{P}alerts_firing {len(self.active_alerts)}")
+            for name in ("steps", "requests", "faults", "restarts",
+                         "flight_dumps"):
+                lines.append(f"# TYPE {P}{name}_total counter")
+                lines.append(f"{P}{name}_total {self.counters[name]}")
+            lines.append(f"{P}health_ok "
+                         f"{1 if self.health == 'ok' else 0}")
+            return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------- HTTP server
+
+
+class StatusServer:
+    """stdlib status endpoint: GET /status.json and /metrics on
+    127.0.0.1:`port` (port 0 picks a free one — read `.port`). Runs on
+    a daemon thread; `close()` shuts it down. No auth, loopback bind —
+    an operator tunnel (ssh -L) is the expected transport, same as
+    jax's profiler server."""
+
+    def __init__(self, monitor: Monitor, port: int = 0,
+                 host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        mon = monitor
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    if self.path.split("?")[0] in ("/status.json",
+                                                   "/status", "/"):
+                        body = json.dumps(mon.status(),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    elif self.path.split("?")[0] == "/metrics":
+                        body = mon.prometheus().encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:   # a status bug must not 500-loop
+                    body = json.dumps({"error": repr(e)}).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # no per-request stderr spam
+                pass
+
+        self._srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="monitor-http",
+                                        daemon=True)
+        self._thread.start()
+
+    def url(self, path: str = "/status.json") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5)
+
+
+# ------------------------------------------------- driver-side wiring
+
+
+def from_args(args, metrics, flight_dir=None):
+    """One-call driver wiring: build the Monitor + StatusServer when
+    any of --monitor-port / --slo / --flight-recorder is set, attach
+    it to the MetricsLogger (every logged line flows into
+    `note_line`), and return (monitor, server) — (None, None) when the
+    plane is off. The caller owns `close_monitor(monitor, server)` at
+    teardown."""
+    port = getattr(args, "monitor_port", None)
+    slo = getattr(args, "slo", "") or ""
+    flight = int(getattr(args, "flight_recorder", 0) or 0)
+    if port is None and not slo and not flight:
+        return None, None
+    if flight_dir is None:
+        log_file = getattr(args, "log_file", "") or ""
+        flight_dir = Path(log_file).parent if log_file else Path(".")
+    mon = Monitor(slos=slo, flight=flight, flight_dir=flight_dir,
+                  emit=metrics.log if metrics is not None else None)
+    if metrics is not None:
+        metrics.monitor = mon
+    server = StatusServer(mon, port=port) if port is not None else None
+    return mon, server
+
+
+def close_monitor(monitor, server) -> None:
+    if server is not None:
+        server.close()
+    if monitor is not None:
+        monitor.close()
+
+
+# ------------------------------------------------------- live tailer
+
+
+def iter_jsonl(path, pos: int = 0):
+    """Parse records from `path` starting at byte `pos`; returns
+    (records, new_pos). Tolerates a partial last line (the writer may
+    be mid-append) by not consuming it."""
+    recs = []
+    try:
+        with open(path, "rb") as f:
+            f.seek(pos)
+            data = f.read()
+    except OSError:
+        return recs, pos
+    if not data:
+        return recs, pos
+    end = data.rfind(b"\n")
+    if end < 0:
+        return recs, pos
+    for line in data[:end].splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            recs.append(json.loads(line))
+        except ValueError:
+            continue
+    return recs, pos + end + 1
+
+
+class FileTailer(threading.Thread):
+    """Daemon thread feeding a growing metrics JSONL into a Monitor —
+    the elastic supervisor's aggregation path (the ledger file spans
+    every child stanza, so one tailer sees the whole gang history)."""
+
+    def __init__(self, path, monitor: Monitor, poll: float = 0.5):
+        super().__init__(name="monitor-tail", daemon=True)
+        self.path = str(path)
+        self.monitor = monitor
+        self.poll = float(poll)
+        # NOT named _stop: threading.Thread owns that attribute (its
+        # join machinery calls self._stop() internally)
+        self._halt = threading.Event()
+        self._pos = 0
+
+    def drain(self) -> int:
+        recs, self._pos = iter_jsonl(self.path, self._pos)
+        for rec in recs:
+            self.monitor.note_line(rec)
+        return len(recs)
+
+    def run(self):
+        while not self._halt.is_set():
+            self.drain()
+            self._halt.wait(self.poll)
+        self.drain()
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=5)
+
+
+def format_status(status: dict) -> str:
+    """Human-readable rendering of one /status.json payload (the
+    --live terminal view)."""
+    lines = []
+    up = status.get("uptime_s")
+    head = [f"uptime {up:.0f}s" if up is not None else "uptime —"]
+    for key in ("goodput_so_far", "availability"):
+        v = status.get(key)
+        if v is not None:
+            head.append(f"{key.replace('_so_far', '')} {v:.1%}")
+    head.append(f"health {status.get('health', '?')}")
+    lines.append("  ".join(head))
+    ls = status.get("last_step")
+    if ls:
+        bits = [f"step {ls.get('step')}"]
+        if isinstance(ls.get("loss"), (int, float)):
+            bits.append(f"loss {ls['loss']:.4f}")
+        if isinstance(ls.get("tokens_per_sec"), (int, float)):
+            bits.append(f"tok/s {ls['tokens_per_sec']:,.0f}")
+        lines.append("  ".join(bits))
+    for name, sk in (status.get("sketches") or {}).items():
+        lines.append(
+            f"  {name:<12} n={sk['count']:<7} p50 {sk.get('p50')}  "
+            f"p95 {sk.get('p95')}  p99 {sk.get('p99')}  "
+            f"[{sk.get('min')} .. {sk.get('max')}]")
+    srv = status.get("serving")
+    if srv:
+        lines.append("  serving " + "  ".join(
+            f"{k}={v}" for k, v in sorted(srv.items())))
+    for s in status.get("slo") or []:
+        lines.append(f"  slo {s['slo']:<24} {s['state']:<8} "
+                     f"burn fast/slow {s['burn_fast']}/{s['burn_slow']}")
+    for a in status.get("alerts") or []:
+        lines.append(f"  ALERT {a.get('severity', '?').upper()} "
+                     f"{a.get('slo')} burn {a.get('burn_fast')}/"
+                     f"{a.get('burn_slow')}")
+    lf = status.get("last_fault")
+    if lf:
+        lines.append(f"  last fault: {lf.get('kind')} "
+                     f"(step {lf.get('step')})")
+    for p in status.get("flight_dumps") or []:
+        lines.append(f"  flight recorder: {p}")
+    return "\n".join(lines)
+
+
+def live_main(path, slos: str = "", once: bool = False,
+              interval: float = 2.0, out=print, max_secs=None) -> int:
+    """``python -m shallowspeed_tpu.telemetry --live <jsonl>``: tail a
+    growing metrics file and render the same view the /status.json
+    endpoint serves — live monitoring for runs started without
+    --monitor-port. `once` renders the current state and exits (the
+    pre-commit smoke); otherwise polls until Ctrl-C / `max_secs`."""
+    mon = Monitor(slos=slos, flight=0, derive_steps=True,
+                  snapshot_every=0)
+    pos = 0
+    t0 = time.time()
+    if not Path(path).exists() and once:
+        out(f"--live: no such file {path}")
+        return 1
+    while True:
+        recs, pos = iter_jsonl(path, pos)
+        for rec in recs:
+            mon.note_line(rec)
+        out(f"== {path} @ {time.strftime('%H:%M:%S')} "
+            f"({mon.counters['lines']} lines)")
+        out(format_status(mon.status()))
+        if once or (max_secs is not None
+                    and time.time() - t0 >= max_secs):
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0

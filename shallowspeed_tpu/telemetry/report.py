@@ -25,6 +25,7 @@ fields appear at the first log point after a step; an engine without
 from __future__ import annotations
 
 import json
+import math
 from pathlib import Path
 
 import numpy as np
@@ -36,12 +37,22 @@ MiB = float(1 << 20)
 
 def percentile(vals, q: float) -> float | None:
     """Nearest-rank percentile (q in [0, 100]) without numpy dtype
-    surprises — None on empty input. Shared by the request-latency
-    summary below and the goodput reducer's serving block."""
+    surprises — None on empty input. The ONE quantile definition the
+    repo shares: the request-latency summary below, the goodput
+    reducer's serving block, the attribution q25 step-time pick, and
+    the streaming sketches (`sketch.LogHistogram.quantile`) all use
+    this rank rule, so live and offline quantiles can only disagree by
+    the sketch's documented rel_err — never by rank convention.
+
+    Rank = floor(q/100 * (n-1) + 0.5): round-HALF-UP. Python's
+    round() rounds half to even (banker's), which maps an exact .5
+    rank DOWN whenever the lower rank is even — p50 of 18 samples
+    would read sample 8, not 9."""
     vals = sorted(float(v) for v in vals)
     if not vals:
         return None
-    k = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+    k = min(len(vals) - 1,
+            max(0, math.floor(q / 100.0 * (len(vals) - 1) + 0.5)))
     return vals[k]
 
 
@@ -327,8 +338,10 @@ class RunTelemetry:
         # (descheduled steps run ~2x slow) and the median flips modes
         # window to window — q25 tracks the repeatable fast mode,
         # which is the quantity whose drift means the PROGRAM got
-        # slower (the alarm) rather than the host got busy (noise)
-        t_step = float(np.percentile(durs, 25))
+        # slower (the alarm) rather than the host got busy (noise).
+        # Same nearest-rank helper as the request-latency quantiles —
+        # step-time and serving percentiles share ONE definition.
+        t_step = percentile(durs, 25)
         if t_step <= 0.0:
             return {}
         roof = None

@@ -44,11 +44,18 @@ from pathlib import Path
 # completion, preemption count, tokens in/out) the `--goodput`
 # reducer turns into p50/p95 ttft/tpot — and the serving fields the
 # periodic `"generate"` tick lines grew (queue_depth, active_slots,
-# free_blocks, the live-blocks HBM sweep). The validator accepts ALL
-# dialects — every versioned field is optional, so committed v1-v5
-# artifacts (no version stamp / no health / overlap / attrib / wall /
-# fault / request fields) keep validating unchanged.
-SCHEMA_VERSION = 6
+# free_blocks, the live-blocks HBM sweep); 7 = v6 plus the live
+# telemetry plane (round 12, `telemetry/monitor.py` + `sketch.py`):
+# `"monitor"` events — periodic serializations of the streaming
+# log-bucketed histogram sketches (step time, ttft/tpot, tok/s, queue
+# depth, free blocks), mergeable across processes/stanzas so the
+# supervisor and `--goodput` can recombine them into whole-run
+# quantiles — and `"alert"` events stamped by the SLO burn-rate
+# evaluator (--slo) at every state transition. The validator accepts
+# ALL dialects — every versioned field is optional, so committed
+# v1-v6 artifacts (no version stamp / no health / overlap / attrib /
+# wall / fault / request / monitor fields) keep validating unchanged.
+SCHEMA_VERSION = 7
 
 _NUM = (int, float)
 
@@ -79,6 +86,13 @@ _METRIC_EVENTS = {
     # record the --goodput reducer turns into ttft/tpot percentiles
     "request": {"id": str, "ttft_ms": _NUM, "tokens_in": int,
                 "tokens_out": int},
+    # schema v7: periodic streaming-sketch snapshot (telemetry/
+    # monitor.Monitor) — per-metric log-bucketed histograms,
+    # mergeable across processes into whole-run quantiles
+    "monitor": {"sketches": dict},
+    # schema v7: SLO burn-rate state transition (fire / escalate /
+    # resolve) from the --slo evaluator
+    "alert": {"slo": str, "state": str},
 }
 
 # optional typed fields on a "ledger" line (`fail_class`: the
@@ -95,6 +109,12 @@ _FAULT_OPTIONAL = {"step": int, "save": int, "seconds": _NUM,
 # inter-token interval to average
 _REQUEST_OPTIONAL = {"tpot_ms": _NUM, "e2e_ms": _NUM, "wait_ms": _NUM,
                      "queue_depth": int, "preempted": int}
+
+# optional typed fields on the schema-v7 events
+_MONITOR_OPTIONAL = {"counters": dict, "rel_err": _NUM}
+_ALERT_OPTIONAL = {"severity": str, "metric": str, "burn_fast": _NUM,
+                   "burn_slow": _NUM, "value": _NUM,
+                   "threshold": _NUM, "step": int}
 
 # telemetry fields a step line MAY carry; when present they must type
 _STEP_TELEMETRY = {
@@ -174,6 +194,13 @@ def _validate_metric(rec: dict) -> list[str]:
             if field in rec and (not isinstance(rec[field], typ)
                                  or isinstance(rec[field], bool)):
                 probs.append(f"request: field {field!r} is "
+                             f"{type(rec[field]).__name__}")
+    if ev in ("monitor", "alert"):
+        opt = _MONITOR_OPTIONAL if ev == "monitor" else _ALERT_OPTIONAL
+        for field, typ in opt.items():
+            if field in rec and (not isinstance(rec[field], typ)
+                                 or isinstance(rec[field], bool)):
+                probs.append(f"{ev}: field {field!r} is "
                              f"{type(rec[field]).__name__}")
     # schema v4: any metrics line may carry an absolute `wall` stamp
     if "wall" in rec and not isinstance(rec["wall"], _NUM):

@@ -136,6 +136,11 @@ class Tracer:
         self._counters: dict[str, float] = {}
         self._lock = threading.Lock()
         self._jsonl = None
+        # span-event subscribers (round 12): the live monitor's
+        # flight recorder rides here so the incident ring holds the
+        # phase spans next to the metrics lines. Called under the
+        # emit lock — keep them O(ring append) cheap.
+        self.subscribers: list = []
         if self.dir is not None and level != "off":
             self.dir.mkdir(parents=True, exist_ok=True)
             # "w", not "a": each run owns its trace dir (appending a
@@ -192,6 +197,11 @@ class Tracer:
             if self._jsonl is not None:
                 self._jsonl.write(json.dumps(ev) + "\n")
                 self._jsonl.flush()
+            for fn in self.subscribers:
+                try:
+                    fn(ev)
+                except Exception:
+                    pass  # a monitor bug must not kill the traced run
 
     # ----------------------------------------------------------- export
 

@@ -1,0 +1,709 @@
+"""Live telemetry plane (round 12): streaming sketches, /status.json +
+/metrics endpoints, SLO burn-rate alerts, flight recorder.
+
+Acceptance pins:
+- live-vs-offline parity: sketch quantiles served from /status.json
+  during a scripted serving run match the post-hoc --goodput p50/p95
+  ttft/tpot within the sketch's documented relative-error bound
+  (`test_serving_live_status_matches_offline_goodput` — the default-
+  tier canary; the subprocess end-to-ends ride the slow tier);
+- a seeded chaos NaN-poison run leaves a flightrec_*.json whose last
+  ring entry is the poisoned step
+  (`test_chaos_nan_poison_leaves_flightrec` — slow tier, like PR 6's
+  full chaos suite; `test_monitor_fault_line_triggers_flight_dump`
+  pins the same ring/dump logic in-process in the default tier);
+- `report.percentile` is round-half-up nearest-rank (banker's-rounding
+  regression fixture) and is the ONE quantile definition step-time and
+  request-latency reductions share.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.telemetry.monitor import (FileTailer, FlightRecorder,
+                                                Monitor, SloRule,
+                                                StatusServer, live_main,
+                                                parse_slos)
+from shallowspeed_tpu.telemetry.report import percentile
+from shallowspeed_tpu.telemetry.sketch import LogHistogram, MetricSketches
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+# ------------------------------------------------------------- sketch
+
+
+def test_sketch_quantiles_within_documented_rel_err():
+    rng = random.Random(7)
+    vals = [rng.lognormvariate(2.0, 1.5) for _ in range(4000)]
+    sk = LogHistogram(rel_err=0.01)
+    for v in vals:
+        sk.add(v)
+    assert sk.n == len(vals)
+    for q in (1, 25, 50, 90, 95, 99):
+        exact = percentile(vals, q)
+        est = sk.quantile(q)
+        assert abs(est - exact) <= 0.01 * exact + 1e-12, (q, est, exact)
+    assert abs(sk.mean() - sum(vals) / len(vals)) < 1e-9
+    assert sk.vmin == min(vals) and sk.vmax == max(vals)
+
+
+def test_sketch_merge_equals_union_and_roundtrips():
+    rng = random.Random(3)
+    vals = [rng.expovariate(0.1) for _ in range(1000)]
+    whole = LogHistogram(0.02)
+    a, b = LogHistogram(0.02), LogHistogram(0.02)
+    for i, v in enumerate(vals):
+        whole.add(v)
+        (a if i % 2 else b).add(v)
+    a.merge(b)
+    for q in (50, 95, 99):
+        assert a.quantile(q) == whole.quantile(q)
+    # JSON round-trip (the schema-v7 "monitor" payload)
+    back = LogHistogram.from_dict(json.loads(json.dumps(a.to_dict())))
+    assert back.n == whole.n
+    assert back.quantile(95) == whole.quantile(95)
+    with pytest.raises(ValueError):
+        a.merge(LogHistogram(0.01))
+
+
+def test_sketch_zero_negative_and_empty():
+    sk = LogHistogram(0.01)
+    assert sk.quantile(50) is None
+    sk.add(0.0, count=3)
+    sk.add(-2.0)
+    sk.add(10.0)
+    assert sk.n == 5
+    assert sk.quantile(50) <= 0.0      # rank 2 is in the zero bucket
+    assert sk.quantile(99) <= 10.0 * 1.01
+    sk.add(float("nan"))               # ignored, not poisoned
+    assert sk.n == 5
+
+
+def test_metric_sketches_merge_dict():
+    a, b = MetricSketches(0.01), MetricSketches(0.01)
+    for i in range(50):
+        a.observe("ttft_ms", 10 + i)
+        b.observe("ttft_ms", 200 + i)
+        b.observe("tok_s", 5 * i + 1)
+    a.merge_dict(b.to_dict())
+    assert a.sketches["ttft_ms"].n == 100
+    assert "tok_s" in a.sketches
+    assert a.quantile("ttft_ms", 95) > 200
+
+
+# --------------------------------------------- percentile (satellite)
+
+
+def test_percentile_round_half_up_not_bankers():
+    # rank = 0.5 * 17 = 8.5: round() would give 8 (half-to-even);
+    # floor(+0.5) must give 9
+    assert percentile(list(range(18)), 50) == 9
+    # even-rank p95 fixture: 0.95 * 30 = 28.5 -> banker's 28, ours 29
+    assert percentile(list(range(31)), 95) == 29
+    assert percentile([], 50) is None
+    assert percentile([3.0], 99) == 3.0
+    assert percentile([1.0, 2.0], 0) == 1.0
+    assert percentile([1.0, 2.0], 100) == 2.0
+
+
+def test_percentile_is_shared_with_sketch_rank_rule():
+    # same nearest-rank rule: on well-separated values the sketch must
+    # pick the SAME sample (bucket error <<< gaps)
+    vals = [1.0, 10.0, 100.0, 1000.0, 10000.0, 100000.0]
+    sk = LogHistogram(0.001)
+    for v in vals:
+        sk.add(v)
+    for q in (0, 10, 50, 75, 95, 100):
+        exact = percentile(vals, q)
+        assert abs(sk.quantile(q) - exact) <= 0.001 * exact
+
+
+# ---------------------------------------------------------------- SLO
+
+
+def test_slo_parsing_good_and_bad():
+    rules = parse_slos("ttft_p95_ms<500, availability>0.99,"
+                       "step_p99_ms<250,tok_s_p50>100")
+    assert [r.sketch for r in rules] == ["ttft_ms", None, "step_ms",
+                                         "tok_s"]
+    assert rules[0].q == 95 and rules[0].budget == pytest.approx(0.05)
+    assert rules[1].budget == pytest.approx(0.01)
+    assert parse_slos("") == [] and parse_slos("  ") == []
+    for bad in ("ttft_ms<500", "availability<0.99", "availability>2",
+                "p95<1", "ttft_p95_ms=500", "nope"):
+        with pytest.raises(ValueError):
+            parse_slos(bad)
+
+
+def test_burn_rate_dual_window_blip_vs_sustained():
+    rule = SloRule("ttft_p95_ms<100", fast_s=10, slow_s=100,
+                   warn_burn=2.0, critical_burn=10.0, min_count=1)
+    t = 1000.0
+    # 95 good observations over 95s of history
+    for i in range(95):
+        rule.record(50.0, t + i)
+    t += 95
+    # a 5-observation bad BLIP: fast window burns hot, the slow
+    # window's bad fraction is 5/100 = exactly budget -> burn 1 < 2,
+    # so the dual-window rule does NOT page
+    for i in range(5):
+        rule.record(500.0, t + i)
+    t += 5
+    assert rule.burn(rule.fast_s, t) >= 10.0
+    assert rule.burn(rule.slow_s, t) <= 1.1
+    assert rule.evaluate(t) is None and rule.state is None
+    # SUSTAINED badness: both windows burn -> critical fire, then a
+    # recovery resolves
+    for i in range(60):
+        rule.record(500.0, t + i)
+    t += 60
+    alert = rule.evaluate(t)
+    assert alert is not None and alert["state"] == "firing"
+    assert alert["severity"] == "critical"
+    assert rule.evaluate(t) is None        # no re-fire while steady
+    for i in range(200):
+        rule.record(50.0, t + i)
+    t += 200
+    resolved = rule.evaluate(t)
+    assert resolved is not None and resolved["state"] == "resolved"
+
+
+def test_availability_slo_burns_on_downtime():
+    rule = SloRule("availability>0.9", fast_s=10, slow_s=100,
+                   warn_burn=2.0, critical_burn=50.0)
+    t = 500.0
+    rule.record_down(30.0, t)
+    # fast: 30/(10*0.1)=30, slow: 30/(100*0.1)=3 -> warn fires
+    alert = rule.evaluate(t)
+    assert alert is not None and alert["severity"] == "warn"
+    # the downtime ages out of both windows -> resolve
+    resolved = rule.evaluate(t + 200)
+    assert resolved is not None and resolved["state"] == "resolved"
+
+
+# ------------------------------------------------------------ monitor
+
+
+def _mk_monitor(**kw):
+    kw.setdefault("slo_kw", dict(fast_s=10, slow_s=60, min_count=3))
+    return Monitor(**kw)
+
+
+def test_monitor_ingests_serving_lines_and_serves_status(tmp_path):
+    clock = [2000.0]
+    mon = _mk_monitor(slos="ttft_p95_ms<100", flight=16,
+                      flight_dir=tmp_path, clock=lambda: clock[0])
+    fired = []
+    mon.alert_listeners.append(fired.append)
+    for i in range(20):
+        clock[0] += 1
+        mon.note_line({"event": "request", "id": f"r{i}",
+                       "ttft_ms": 250.0, "tpot_ms": 3.0,
+                       "tokens_in": 4, "tokens_out": 4,
+                       "queue_depth": 2, "wall": clock[0]})
+    mon.note_line({"event": "generate", "tokens_per_sec": 120.0,
+                   "queue_depth": 1, "free_blocks": 7,
+                   "active_slots": 3, "wall": clock[0]})
+    st = mon.status()
+    assert st["sketches"]["ttft_ms"]["count"] == 20
+    assert st["sketches"]["tpot_ms"]["p95"] == pytest.approx(3.0,
+                                                             rel=0.02)
+    assert st["sketches"]["free_blocks"]["count"] == 1
+    assert st["serving"]["active_slots"] == 3
+    assert st["counters"]["requests"] == 20
+    # the sustained 250ms ttft fires the SLO; the trip also dumps the
+    # flight ring
+    assert fired and fired[0]["state"] == "firing"
+    assert st["alerts"] and st["alerts"][0]["slo"] == "ttft_p95_ms<100"
+    assert mon.flight.dumps
+    dump = json.loads(Path(mon.flight.dumps[0]).read_text())
+    assert dump["ring"][-1]["event"] in ("request", "generate")
+    prom = mon.prometheus()
+    assert "shallowspeed_ttft_ms{quantile=\"0.95\"}" in prom
+    assert "shallowspeed_alerts_firing 1" in prom
+    assert "shallowspeed_requests_total 20" in prom
+
+
+def test_monitor_goodput_and_availability_from_ledger_lines():
+    mon = _mk_monitor()
+    mon.note_line({"event": "run_start", "wall": 100.0})
+    mon.note_line({"event": "ledger", "kind": "init", "seconds": 5.0,
+                   "wall": 105.0})
+    mon.note_line({"event": "ledger", "kind": "restart_downtime",
+                   "seconds": 10.0, "wall": 150.0})
+    mon.note_line({"event": "step", "step": 5, "loss": 1.0,
+                   "tokens_per_sec": 10.0, "wall": 200.0})
+    assert mon.goodput_so_far() == pytest.approx(1 - 15.0 / 100.0)
+    assert mon.availability() == pytest.approx(1 - 10.0 / 100.0)
+    assert mon.counters["restarts"] == 1
+
+
+def test_monitor_fault_line_triggers_flight_dump(tmp_path):
+    mon = _mk_monitor(flight=8, flight_dir=tmp_path)
+    mon.note_line({"event": "step", "step": 4, "loss": 1.0,
+                   "tokens_per_sec": 5.0, "wall": 10.0})
+    mon.note_line({"event": "fault", "kind": "nan", "step": 5,
+                   "wall": 11.0})
+    assert len(mon.flight.dumps) == 1
+    dump = json.loads(Path(mon.flight.dumps[0]).read_text())
+    assert dump["reason"] == "fault:nan" and dump["step"] == 5
+    assert dump["ring"][-1]["event"] == "fault"
+    assert dump["ring"][-1]["step"] == 5
+    # same (reason, step) never dumps twice
+    mon.note_line({"event": "fault", "kind": "nan", "step": 5,
+                   "wall": 12.0})
+    assert len(mon.flight.dumps) == 1
+
+
+def test_flight_recorder_ring_capacity_and_dump_cap(tmp_path):
+    fr = FlightRecorder(capacity=4, out_dir=tmp_path, max_dumps=2)
+    for i in range(10):
+        fr.record({"i": i})
+    assert [r["i"] for r in fr.ring] == [6, 7, 8, 9]
+    assert fr.dump("a", step=1) and fr.dump("b", step=2)
+    assert fr.dump("c", step=3) is None          # max_dumps
+    assert len(fr.dumps) == 2
+
+
+def test_monitor_snapshot_emits_and_merges(tmp_path):
+    lines = []
+    emit = lambda **kw: lines.append(kw)  # noqa: E731
+    a = Monitor(emit=emit, snapshot_every=0)
+    b = Monitor(snapshot_every=0)
+    for i in range(40):
+        a.observe("ttft_ms", 10.0 + i)
+        b.observe("ttft_ms", 500.0 + i)
+    a.snapshot()
+    assert lines and lines[0]["event"] == "monitor"
+    assert "ttft_ms" in lines[0]["sketches"]
+    b.merge_snapshot(lines[0])
+    assert b.sketches.sketches["ttft_ms"].n == 80
+    # schema-v7 validation of the emitted line
+    from shallowspeed_tpu.telemetry import schema
+
+    rec = {k: v for k, v in lines[0].items()}
+    assert schema.validate_line(rec) == []
+    assert schema.validate_line({"event": "monitor"}) != []
+    assert schema.validate_line(
+        {"event": "alert", "slo": "x<1", "state": "firing",
+         "burn_fast": 3.0, "severity": "warn"}) == []
+    assert schema.validate_line({"event": "alert", "slo": "x<1"}) != []
+
+
+def test_goodput_monitor_block_tolerates_mixed_rel_err(tmp_path):
+    """Snapshots from mixed-precision producers must reduce (largest
+    same-rel_err group + a skipped count), not crash the reducer."""
+    from shallowspeed_tpu.telemetry.goodput import run_goodput
+
+    def snap(rel, lo):
+        sk = MetricSketches(rel_err=rel)
+        for i in range(20):
+            sk.observe("ttft_ms", lo + i)
+        return {"event": "monitor", "sketches": sk.to_dict(),
+                "rel_err": rel}
+
+    path = tmp_path / "m.jsonl"
+    with open(path, "w") as f:
+        for rec in ({"event": "run_start", "wall": 0.0}, snap(0.01, 10),
+                    {"event": "run_start", "wall": 5.0}, snap(0.01, 50),
+                    {"event": "run_start", "wall": 9.0}, snap(0.02, 90)):
+            f.write(json.dumps(rec) + "\n")
+    rep = run_goodput(path)
+    mon = rep["monitor"]
+    assert mon is not None
+    assert mon["snapshots"] == 2 and mon["rel_err"] == 0.01
+    assert mon["skipped_mixed_rel_err"] == 1
+    assert mon["quantiles"]["ttft_ms"]["count"] == 40
+
+
+def test_metrics_logger_feeds_monitor_without_file():
+    from shallowspeed_tpu.metrics import MetricsLogger
+
+    mon = _mk_monitor()
+    logger = MetricsLogger(None, monitor=mon)
+    logger.log(event="request", id="a", ttft_ms=12.0, tokens_in=1,
+               tokens_out=2)
+    assert mon.counters["requests"] == 1
+    assert mon.sketches.sketches["ttft_ms"].n == 1
+
+
+def test_steprates_feeds_exact_window_rates():
+    from shallowspeed_tpu.metrics import StepRates
+
+    clock = [0.0]
+    mon = _mk_monitor(clock=lambda: clock[0])
+    rates = StepRates(100.0, clock=lambda: clock[0], monitor=mon)
+    clock[0] += 10.0
+    rates.pause(5.0, kind="val")    # excluded: 5 steps over 5 busy secs
+    rates.log_point(5)
+    sk = mon.sketches.sketches["step_ms"]
+    assert sk.n == 5                 # weighted by the window's steps
+    assert sk.quantile(50) == pytest.approx(1000.0, rel=0.02)
+    assert mon.sketches.sketches["tok_s"].n == 1
+    assert mon.sketches.quantile("tok_s", 50) == pytest.approx(
+        100.0, rel=0.02)
+
+
+def test_tailer_derives_steps_and_ignores_monitor_events(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "run_start", "wall": 0.0}) + "\n")
+        for s in range(0, 8, 2):
+            f.write(json.dumps({"event": "step", "step": s,
+                                "loss": 1.0, "tokens_per_sec": 50.0,
+                                "wall": float(s)}) + "\n")
+        # a monitor snapshot in the file must NOT be re-ingested
+        f.write(json.dumps({"event": "monitor", "sketches": {
+            "ttft_ms": {"rel_err": 0.01, "n": 99, "zero": 0,
+                        "buckets": {"1": 99}}}}) + "\n")
+    mon = Monitor(derive_steps=True, snapshot_every=0)
+    tailer = FileTailer(path, mon)
+    tailer.drain()
+    assert mon.sketches.sketches["step_ms"].n == 6      # steps 0->6
+    assert mon.sketches.quantile("step_ms", 50) == pytest.approx(
+        1000.0, rel=0.02)
+    assert mon.sketches.sketches["tok_s"].n == 4
+    assert "ttft_ms" not in mon.sketches.sketches
+    # incremental: appended lines arrive on the next drain
+    with open(path, "a") as f:
+        f.write(json.dumps({"event": "step", "step": 8, "loss": 1.0,
+                            "tokens_per_sec": 50.0,
+                            "wall": 8.0}) + "\n")
+    tailer.drain()
+    assert mon.sketches.sketches["step_ms"].n == 8
+
+
+def test_status_server_serves_both_endpoints():
+    mon = _mk_monitor()
+    mon.observe("step_ms", 12.0)
+    srv = StatusServer(mon, port=0)
+    try:
+        st = json.loads(urllib.request.urlopen(
+            srv.url("/status.json"), timeout=10).read())
+        assert st["sketches"]["step_ms"]["count"] == 1
+        prom = urllib.request.urlopen(srv.url("/metrics"),
+                                      timeout=10).read().decode()
+        assert prom.startswith("# TYPE shallowspeed_up gauge")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url("/nope"), timeout=10)
+    finally:
+        srv.close()
+
+
+def test_live_main_once_renders_committed_artifact(capsys):
+    rc = live_main(str(ROOT / "docs_runs" / "serving_r07_metrics.jsonl"),
+                   once=True)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ttft_ms" in out and "uptime" in out
+    assert live_main("/nonexistent.jsonl", once=True) == 1
+
+
+# ------------------------------------------- engine load-shed (hook)
+
+
+def test_engine_on_alert_sheds_and_resumes():
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.serving import ServingEngine
+
+    cfg = T.TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                              n_layers=1, max_seq=64)
+    params = jax.device_put(T.init(cfg, seed=0))
+    eng = ServingEngine(params, cfg, n_blocks=24, block_size=8,
+                        max_slots=2, prefill_chunk=8)
+    crit = {"state": "firing", "severity": "critical", "slo": "x<1"}
+    warm = {"state": "firing", "severity": "warn", "slo": "x<1"}
+    done = {"state": "resolved", "severity": "critical", "slo": "x<1"}
+    rng = np.random.default_rng(0)
+    eng.submit(rng.integers(0, 32, 6).astype(np.int32), 4, rid="a")
+    eng.on_alert(crit)
+    assert eng.admission_paused
+    # all-slots-empty carve-out: the scheduler stays live even shed
+    assert eng.step()
+    assert eng.slots[0] is not None
+    # with work in flight, new submissions wait in the queue
+    eng.submit(rng.integers(0, 32, 6).astype(np.int32), 4, rid="b")
+    eng.step()
+    assert any(r.rid == "b" for r in eng.queue)
+    # de-escalation to warn releases the shed (only a CRITICAL burn
+    # keeps admission paused); resolve releases it too
+    eng.on_alert(warm)
+    assert not eng.admission_paused
+    eng.on_alert(crit)
+    eng.on_alert(done)
+    assert not eng.admission_paused
+    # PER-RULE tracking: another SLO's warn/resolve must NOT release
+    # a still-critical rule's shed; only ITS resolve does
+    crit_b = {"state": "firing", "severity": "critical", "slo": "y<2"}
+    done_b = {"state": "resolved", "severity": "critical", "slo": "y<2"}
+    eng.on_alert(crit)
+    eng.on_alert(crit_b)
+    eng.on_alert({"state": "resolved", "severity": "warn", "slo": "z>3"})
+    assert eng.admission_paused
+    eng.on_alert(done)
+    assert eng.admission_paused          # y<2 still burns critical
+    eng.on_alert(done_b)
+    assert not eng.admission_paused
+    eng.run()
+    assert set(eng.results) == {"a", "b"}
+    assert eng.alloc.n_free == eng.alloc.n_usable
+
+
+# ------------------------- acceptance: live-vs-offline parity canary
+
+
+def test_serving_live_status_matches_offline_goodput(tmp_path):
+    """The round-12 acceptance pin: /status.json quantiles DURING a
+    scripted serving run match the post-hoc --goodput percentiles
+    within the sketch's documented rel_err."""
+    import jax
+
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.serving import ServingEngine
+    from shallowspeed_tpu.telemetry import schema
+    from shallowspeed_tpu.telemetry.goodput import run_goodput
+
+    cfg = T.TransformerConfig(vocab=48, d_model=24, n_heads=2,
+                              n_layers=2, max_seq=96)
+    params = jax.device_put(T.init(cfg, seed=1))
+    path = tmp_path / "serve.jsonl"
+    metrics = MetricsLogger(path, kind="serve")
+    mon = Monitor(slos="", flight=0, emit=metrics.log,
+                  snapshot_every=16)
+    metrics.monitor = mon
+    srv = StatusServer(mon, port=0)
+    try:
+        eng = ServingEngine(params, cfg, n_blocks=48, block_size=8,
+                            max_slots=3, prefill_chunk=16,
+                            metrics=metrics, log_every=4)
+        rng = np.random.default_rng(5)
+        for i in range(7):
+            eng.submit(rng.integers(0, 48, 6 + 3 * i).astype(np.int32),
+                       5 + i, temperature=0.7 if i % 2 else 0.0,
+                       seed=i, rid=f"r{i}")
+        polled = None
+        for _ in range(400):
+            if not eng.pending():
+                break
+            eng.step()
+            # hit the LIVE endpoint mid-run (lock + thread sanity)
+            polled = json.loads(urllib.request.urlopen(
+                srv.url("/status.json"), timeout=10).read())
+        assert not eng.pending()
+        st = json.loads(urllib.request.urlopen(
+            srv.url("/status.json"), timeout=10).read())
+        assert polled is not None and polled["counters"]["lines"] > 0
+    finally:
+        srv.close()
+        mon.close()
+
+    rep = run_goodput(path)
+    off = rep["requests"]
+    assert off["n_requests"] == 7
+    rel = st["rel_err"]
+    for name in ("ttft_ms", "tpot_ms"):
+        for q in (50, 95):
+            live = st["sketches"][name][f"p{q}"]
+            exact = off[f"{name}_p{q}"]
+            # + 1e-3: both sides round to ms decimals for the report
+            assert abs(live - exact) <= rel * abs(exact) + 1e-3, (
+                name, q, live, exact)
+    # the reducer's own merged-sketch cross-check agrees
+    assert rep["monitor"] is not None
+    assert rep["monitor"]["parity"], rep["monitor"]
+    assert all(v["within_bound"]
+               for v in rep["monitor"]["parity"].values())
+    # the file (request + generate + monitor events) validates v7
+    assert schema.validate_file(path) == []
+
+
+# ------------------------------- committed artifacts (satellite gate)
+
+
+@pytest.mark.parametrize(
+    "artifact",
+    sorted(p.name for p in (ROOT / "docs_runs").glob("*.jsonl")))
+def test_committed_artifact_validates_current_schema(artifact):
+    """EVERY committed docs_runs JSONL must validate against the
+    current schema — one parametrized gate instead of each PR
+    hand-listing its own artifact (v1-v7 dialects all accepted)."""
+    from shallowspeed_tpu.telemetry.schema import validate_file
+
+    assert validate_file(ROOT / "docs_runs" / artifact) == []
+
+
+# --------------------------------------------- subprocess end-to-ends
+
+
+def _run(cmd, cwd, timeout=240, **kw):
+    return subprocess.run([sys.executable, *cmd], cwd=cwd,
+                          capture_output=True, text=True,
+                          timeout=timeout, **kw)
+
+
+def _lm_args(tmp_path, steps=12):
+    return ["train_lm.py", "--platform", "cpu", "--steps", str(steps),
+            "--log-every", "2", "--batch-size", "2", "--seq-len", "16",
+            "--d-model", "16", "--n-layers", "1", "--n-heads", "2",
+            "--vocab", "32", "--log-file",
+            str(tmp_path / "metrics.jsonl")]
+
+
+def test_chaos_nan_poison_leaves_flightrec(tmp_path):
+    """Acceptance: a seeded chaos NaN-poison run leaves a
+    flightrec_*.json whose last ring entry is the poisoned step."""
+    r = _run(_lm_args(tmp_path) + [
+        "--chaos", "nan@6", "--chaos-state", str(tmp_path / "cs"),
+        "--flight-recorder", "32", "--health", "monitor"], ROOT)
+    # the NaN loss exits through the labeled divergence path
+    assert r.returncode != 0
+    assert "non-finite" in r.stdout + r.stderr
+    recs = [json.loads(l) for l in
+            (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert any(rec.get("event") == "fault" and rec.get("step") == 6
+               for rec in recs)
+    dumps = sorted(tmp_path.glob("flightrec_*.json"))
+    assert dumps, list(tmp_path.iterdir())
+    fr = json.loads(dumps[0].read_text())
+    assert fr["step"] == 6
+    last = fr["ring"][-1]
+    assert last["event"] == "fault" and last["step"] == 6
+
+
+def test_train_lm_monitor_endpoint_live(tmp_path):
+    """--monitor-port 0 on the LM driver: the printed URL serves
+    /status.json with step sketches while the run is alive, and the
+    JSONL carries validating schema-v7 monitor snapshots."""
+    proc = subprocess.Popen(
+        [sys.executable] + _lm_args(tmp_path, steps=60)
+        + ["--monitor-port", "0", "--slo", "step_p95_ms<10000000"],
+        cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env={**os.environ, "PYTHONUNBUFFERED": "1"})
+    try:
+        url = None
+        t0 = time.time()
+        while time.time() - t0 < 120:
+            line = proc.stdout.readline()
+            if "monitor: http" in line:
+                url = line.split("monitor: ")[1].split(" ")[0]
+                break
+        assert url, "driver never printed the monitor URL"
+        st = None
+        while time.time() - t0 < 180 and proc.poll() is None:
+            try:
+                st = json.loads(urllib.request.urlopen(
+                    url, timeout=5).read())
+                if st["sketches"].get("step_ms", {}).get("count", 0) > 0:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert st is not None and st["sketches"]["step_ms"]["count"] > 0
+        assert st["slo"] and st["slo"][0]["state"] == "ok"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=60)
+    from shallowspeed_tpu.telemetry.schema import validate_file
+
+    assert validate_file(tmp_path / "metrics.jsonl") == []
+
+
+def test_serve_sigterm_flushes_summary_and_snapshot(tmp_path):
+    """Satellite: serve.py converts SIGTERM to SystemExit like the
+    train drivers, so a supervisor kill flushes the request/ledger
+    tail and a final summary line."""
+    reqs = tmp_path / "reqs.jsonl"
+    with open(reqs, "w") as f:
+        for i in range(30):
+            f.write(json.dumps({"id": f"r{i}", "prompt_len": 12,
+                                "max_new": 40,
+                                "at": 0.2 * i}) + "\n")
+    log = tmp_path / "serve.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, "serve.py", "--platform", "cpu", "--vocab",
+         "32", "--d-model", "16", "--n-heads", "2", "--n-layers", "1",
+         "--max-seq", "128", "--n-blocks", "48", "--block-size", "8",
+         "--slots", "2", "--prefill-chunk", "16", "--requests",
+         str(reqs), "--log-file", str(log), "--log-every", "2"],
+        cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    # wait for real work (first generate/request line), then SIGTERM
+    t0 = time.time()
+    while time.time() - t0 < 180:
+        if log.exists() and any(
+                json.loads(l).get("event") in ("generate", "request")
+                for l in log.read_text().splitlines() if l.strip()):
+            break
+        time.sleep(0.5)
+        assert proc.poll() is None, proc.communicate()
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=60)
+    assert proc.returncode == 143, (proc.returncode, err[-500:])
+    lines = [json.loads(l) for l in out.splitlines() if l.strip()]
+    summaries = [l for l in lines if l.get("event") == "summary"]
+    assert summaries, out[-800:]
+    assert summaries[-1]["ticks"] > 0
+    # the kill left a coherent, validating metrics file behind
+    from shallowspeed_tpu.telemetry.schema import validate_file
+
+    assert validate_file(log) == []
+
+
+def test_supervisor_monitor_endpoint_aggregates(tmp_path):
+    """elastic.py --monitor-port: the supervisor tails the child's
+    metrics file and serves aggregated /status.json + /metrics."""
+    import threading
+
+    from shallowspeed_tpu.elastic import RestartPolicy, Supervisor
+
+    sup = Supervisor(
+        [sys.executable, str(ROOT / "train_lm.py")]
+        + _lm_args(tmp_path, steps=40)[1:],
+        RestartPolicy(max_restarts=1), monitor_port=0)
+    hole = {}
+    orig = sup._start_monitor
+
+    def start():
+        mon, srv, tailer = orig()
+        hole["url"] = srv.url("/metrics")
+        hole["status"] = srv.url("/status.json")
+        return mon, srv, tailer
+
+    sup._start_monitor = start
+    rc = {}
+    th = threading.Thread(target=lambda: rc.setdefault("c", sup.run()))
+    th.start()
+    got = None
+    t0 = time.time()
+    while time.time() - t0 < 180 and th.is_alive():
+        try:
+            st = json.loads(urllib.request.urlopen(
+                hole["status"], timeout=5).read())
+            if st["sketches"].get("step_ms", {}).get("count", 0) > 0:
+                got = st
+                prom = urllib.request.urlopen(
+                    hole["url"], timeout=5).read().decode()
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    th.join(timeout=180)
+    assert rc.get("c") == 0
+    assert got is not None, "endpoint never served step sketches"
+    assert "shallowspeed_step_ms" in prom

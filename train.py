@@ -111,6 +111,19 @@ def parse_args(argv=None):
                    help="write spans.jsonl + trace.json (Chrome/"
                         "Perfetto) + telemetry.json here; implies "
                         "--telemetry steps when the level is off")
+    p.add_argument("--monitor-port", type=int, default=None,
+                   help="live telemetry plane (telemetry/monitor): "
+                        "/status.json + /metrics on 127.0.0.1:PORT "
+                        "while the run is live (0 = free port)")
+    p.add_argument("--slo", type=str, default="",
+                   help="declarative SLOs over dual burn-rate windows "
+                        "(telemetry/monitor DSL); 'alert' events land "
+                        "in --log-file")
+    p.add_argument("--flight-recorder", type=int, default=0,
+                   help="ring of the last N metrics/span records, "
+                        "dumped to flightrec_<step>.json on anomaly "
+                        "verdicts, chaos faults, or SLO alerts "
+                        "(0 = off)")
     p.add_argument("--health", default="off",
                    choices=["off", "monitor", "guard"],
                    help="training-health observability (telemetry/"
@@ -334,6 +347,20 @@ def train(args) -> float:
              if args.telemetry != "off" else None)
     if telem is not None:
         telem.ledger = ledger
+
+    # ---- live telemetry plane (telemetry/monitor.py): endpoint +
+    # SLO alerts + flight recorder, fed by every metrics line
+    from shallowspeed_tpu.telemetry.monitor import (close_monitor,
+                                                    from_args)
+
+    live_mon, live_srv = from_args(args, metrics)
+    if live_mon is not None:
+        chaos.add_observer(live_mon.note_line)
+        if args.telemetry != "off":
+            tracer.subscribers.append(live_mon.record_span)
+        if live_srv is not None:
+            rprint(f"monitor: {live_srv.url('/status.json')} "
+                   f"(+ /metrics)")
     if telem is not None and args.pp > 1:
         telem.set_bubble(bubble_static=tele.static_bubble(
             args.schedule, args.mubatches,
@@ -468,6 +495,9 @@ def train(args) -> float:
         if args.trace_dir:
             path = telem.write_summary(args.trace_dir)
             rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
+    if live_mon is not None:
+        chaos.remove_observer(live_mon.note_line)
+        close_monitor(live_mon, live_srv)
 
     plan = chaos.active()
     if plan is not None and plan.unfired():

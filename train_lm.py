@@ -304,6 +304,24 @@ def parse_args(argv=None):
                         "(streamed), trace.json (Chrome/Perfetto), "
                         "telemetry.json (run summary). Implies "
                         "--telemetry steps when the level is off")
+    p.add_argument("--monitor-port", type=int, default=None,
+                   help="live telemetry plane (telemetry/monitor): "
+                        "serve /status.json + /metrics (Prometheus "
+                        "text) on 127.0.0.1:PORT — streaming sketch "
+                        "quantiles over step time / tok/s, goodput so "
+                        "far, health verdict, last fault — while the "
+                        "run is live (0 = pick a free port)")
+    p.add_argument("--slo", type=str, default="",
+                   help="declarative SLOs over dual burn-rate "
+                        "windows, e.g. 'step_p95_ms<250,"
+                        "availability>0.99'; transitions land as "
+                        "schema-v7 'alert' events in --log-file")
+    p.add_argument("--flight-recorder", type=int, default=0,
+                   help="anomaly flight recorder: ring of the last N "
+                        "metrics/span records, dumped to flightrec_"
+                        "<step>.json (next to --log-file) when an "
+                        "anomaly verdict fires, a chaos fault stamps, "
+                        "or an SLO alert trips (0 = off)")
     p.add_argument("--chaos", type=str, default="",
                    help="deterministic fault injection (shallowspeed_"
                         "tpu.chaos): a seeded plan like "
@@ -843,6 +861,22 @@ def train(args) -> float:
         from shallowspeed_tpu.telemetry.health import HealthMonitor
 
         monitor = HealthMonitor(policy=GuardPolicy.for_mode(args.health))
+    # ---- live telemetry plane (telemetry/monitor.py): streaming
+    # sketches + /status.json + /metrics endpoint + SLO burn-rate
+    # alerts + flight recorder, fed by every metrics line (the logger
+    # forwards them), the exact StepRates window rates, chaos fault
+    # stamps, and (at spans level) the tracer's phase spans
+    from shallowspeed_tpu.telemetry.monitor import (close_monitor,
+                                                    from_args)
+
+    live_mon, live_srv = from_args(args, metrics)
+    if live_mon is not None:
+        chaos.add_observer(live_mon.note_line)
+        if tracer is not None and args.telemetry != "off":
+            tracer.subscribers.append(live_mon.record_span)
+        if live_srv is not None:
+            rprint(f"monitor: {live_srv.url('/status.json')} "
+                   f"(+ /metrics)")
     if telem is not None and hasattr(engine, "schedule_info"):
         # pipeline engines: the verified schedule's static bubble rides
         # on every step line from the start; the measured fraction
@@ -957,9 +991,14 @@ def train(args) -> float:
                                           local_rows(tgt)))
 
     if args.sample_only:
-        with ema_weights():
-            sample_and_print(args, engine, cfg, vocab, text_data,
-                             tokenizer, metrics=metrics)
+        try:
+            with ema_weights():
+                sample_and_print(args, engine, cfg, vocab, text_data,
+                                 tokenizer, metrics=metrics)
+        finally:
+            if live_mon is not None:
+                chaos.remove_observer(live_mon.note_line)
+                close_monitor(live_mon, live_srv)
         return float("nan")
 
     from shallowspeed_tpu.metrics import StepRates
@@ -970,7 +1009,7 @@ def train(args) -> float:
     # time — round-4 endurance lesson). With telemetry on, every
     # log_point line additionally carries the telemetry fields.
     rates = StepRates(args.batch_size * args.seq_len, telemetry=telem,
-                      health=monitor, ledger=ledger)
+                      health=monitor, ledger=ledger, monitor=live_mon)
     # everything before the step loop (imports, engine build, data
     # prep; restore is itemized separately) is init time
     ledger.note("init", seconds=max(0.0, time.time() - t_proc0
@@ -1036,6 +1075,15 @@ def train(args) -> float:
                         fatal = [v for v in verdicts
                                  if v.action == "abort"]
                         if fatal:
+                            if live_mon is not None:
+                                # the process exits before the next
+                                # metrics line — dump the incident
+                                # ring NOW, while it still exists
+                                live_mon.flight_dump(
+                                    "anomaly:" + ",".join(
+                                        v.kind for v in fatal),
+                                    step=step,
+                                    trigger=[str(v) for v in fatal])
                             if args.save_dir:
                                 save_ckpt(f"{args.save_dir}/diverged",
                                           step)
@@ -1065,6 +1113,10 @@ def train(args) -> float:
                         # failure detection: divergence gets a labeled exit
                         # (and the params snapshot when --save-dir is set)
                         # instead of silently training on NaNs
+                        if live_mon is not None:
+                            live_mon.flight_dump(
+                                "divergence:nonfinite_loss", step=step,
+                                trigger={"loss": str(loss)})
                         if args.save_dir:
                             # under diverged/ so checkpoint.latest() keeps
                             # resolving to the last GOOD checkpoint for
@@ -1269,6 +1321,11 @@ def train(args) -> float:
             if args.trace_dir:
                 path = telem.write_summary(args.trace_dir)
                 rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
+        if live_mon is not None:
+            # final sketch snapshot into the JSONL (the offline
+            # merge/parity path reads it), then stop the endpoint
+            chaos.remove_observer(live_mon.note_line)
+            close_monitor(live_mon, live_srv)
         if t_loop_done is not None:
             # loop exit -> here: profiler trace write, prefetch close,
             # tracer flush + summary — wall clock the ledger must name
