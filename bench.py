@@ -634,10 +634,21 @@ def bench_serving() -> dict:
             toks = sum(r["tokens_out"] for r in eng.request_records)
             p50 = lambda k: float(np.median(  # noqa: E731
                 [r[k] for r in eng.request_records if k in r]))
+            # lifecycle phase accounting (round 13): p50 time from
+            # admission to the first decode — the prefill share of
+            # ttft, split out from queueing (wait_ms covers that)
+            prefill = [
+                next(p["wall"] for p in tl if p["phase"] == "decoding")
+                - next(p["wall"] for p in tl if p["phase"] == "admitted")
+                for tl in eng.timelines.values()
+                if any(p["phase"] == "decoding" for p in tl)]
             return {"offered": n, "wall_s": round(wall, 3),
                     "tok_per_sec": round(toks / wall, 2),
                     "ttft_p50_ms": round(p50("ttft_ms"), 2),
-                    "tpot_p50_ms": round(p50("tpot_ms"), 2)}
+                    "tpot_p50_ms": round(p50("tpot_ms"), 2),
+                    "prefill_p50_ms": round(
+                        float(np.median(prefill)) * 1e3, 2)
+                    if prefill else None}
 
         # compile warmup (excluded): n=4 walks the tick through BOTH
         # table-width buckets the levels use (W=4 early, W=8 once the

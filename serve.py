@@ -67,7 +67,27 @@ def parse_args(argv=None):
                    help="metrics JSONL (request/generate events)")
     p.add_argument("--log-every", type=int, default=16,
                    help="decode ticks between 'generate' stat lines")
+    c = p.add_argument_group("chaos (shallowspeed_tpu.chaos)")
+    c.add_argument("--chaos", default="",
+                   help="tick-indexed fault plan for THIS server "
+                        "(chaos DSL, e.g. 'stall@4:0.5,kill@9'; step "
+                        "faults index engine ticks) — serving drills "
+                        "of the recovery/observability stack")
+    c.add_argument("--chaos-state", default="",
+                   help="fired-fault marker dir (must survive "
+                        "restarts under a supervisor)")
+    c.add_argument("--chaos-seed", type=int, default=0)
     o = p.add_argument_group("live monitoring (telemetry/monitor)")
+    o.add_argument("--replica", default=None,
+                   help="replica label for fleet views: stamped on "
+                        "the run_start line and served from "
+                        "/status.json, so a FleetCollector names this "
+                        "process in per-replica breakdowns and "
+                        "straggler events")
+    o.add_argument("--fleet-register", default=None, metavar="URL",
+                   help="announce this replica's own monitor endpoint "
+                        "to a fleet collector (POST URL/register; "
+                        "needs --monitor-port)")
     o.add_argument("--monitor-port", type=int, default=None,
                    help="serve /status.json + /metrics (Prometheus "
                         "text) on 127.0.0.1:PORT while the run is "
@@ -157,12 +177,22 @@ def main(argv=None) -> int:
     else:
         params = jax.device_put(T.init(cfg, seed=args.init_seed))
     reqs = load_requests(args.requests, cfg.vocab)
-    metrics = MetricsLogger(
-        args.log_file, kind="serve", vocab=cfg.vocab,
-        d_model=cfg.d_model, n_layers=cfg.n_layers,
-        n_blocks=args.n_blocks, block_size=args.block_size,
-        slots=args.slots, prefill_chunk=args.prefill_chunk,
-        kv_quant=args.kv_quant)
+    run_info = dict(kind="serve", vocab=cfg.vocab,
+                    d_model=cfg.d_model, n_layers=cfg.n_layers,
+                    n_blocks=args.n_blocks, block_size=args.block_size,
+                    slots=args.slots, prefill_chunk=args.prefill_chunk,
+                    kv_quant=args.kv_quant)
+    if args.replica:
+        run_info["replica"] = args.replica
+    metrics = MetricsLogger(args.log_file, **run_info)
+    # chaos (serving drills): tick-indexed faults through the same
+    # plan machinery the train drivers use; fault stamps land in this
+    # replica's metrics JSONL so fleet views see what was injected
+    from shallowspeed_tpu import chaos
+
+    chaos.setup(args.chaos, seed=args.chaos_seed,
+                state_dir=args.chaos_state or None,
+                log_file=args.log_file)
     eng = ServingEngine(
         params, cfg, n_blocks=args.n_blocks,
         block_size=args.block_size, max_slots=args.slots,
@@ -185,6 +215,30 @@ def main(argv=None) -> int:
               flush=True)
     if mon is not None and args.shed_load:
         mon.alert_listeners.append(eng.on_alert)
+    if args.fleet_register:
+        # announce this replica to a fleet collector (best effort —
+        # the fleet may come up after us and poll-register instead)
+        if server is None:
+            p_err = ("--fleet-register needs --monitor-port (the "
+                     "fleet polls our endpoint)")
+            raise SystemExit(p_err)
+        import urllib.request
+
+        body = json.dumps({
+            "url": server.url("/status.json"),
+            "name": args.replica or f"pid{__import__('os').getpid()}",
+        }).encode()
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                args.fleet_register.rstrip("/") + "/register",
+                data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=5).read()
+        except Exception as e:
+            print(json.dumps({"event": "error",
+                              "error": f"fleet register failed: "
+                                       f"{type(e).__name__}: {e}"}),
+                  flush=True)
 
     t0 = time.time()
     i = 0

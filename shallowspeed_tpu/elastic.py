@@ -122,6 +122,21 @@ def _argv_log_file(argv: list[str]) -> str | None:
     return None
 
 
+def _set_argv_log_file(argv: list[str], path: str) -> list[str]:
+    """A copy of `argv` with its --log-file value replaced (both
+    forms) — gang mode rewrites the shared file to per-member files
+    so N children's stanzas never interleave in one JSONL."""
+    out = list(argv)
+    for i, arg in enumerate(out):
+        if arg == "--log-file" and i + 1 < len(out):
+            out[i + 1] = path
+            return out
+        if arg.startswith("--log-file="):
+            out[i] = f"--log-file={path}"
+            return out
+    return out + ["--log-file", path]
+
+
 def write_heartbeat(path, status: str = "ok") -> None:
     """One beat: refresh the mtime and record the health status."""
     with open(path, "w") as f:
@@ -565,17 +580,27 @@ class GangSupervisor(Supervisor):
         self.log = log
         self.term_grace = term_grace
         self.child_env = dict(child_env or {})
-        # gang monitoring aggregates process 0's metrics file (the
-        # gang note below: a SHARED --log-file would interleave N
-        # stanzas; per-member files are per-member monitors)
         self.monitor_port = monitor_port
         self.slo = slo or ""
         self.heartbeat_file = None  # per-member files; see below
         self._poison_step = None
         self._poison_count = 0
-        # gang note: a shared --log-file would interleave N processes'
-        # stanzas; restart stamps still help process 0's file
-        self.ledger_file = ledger_file or _argv_log_file(self.argv)
+        # gang monitoring (round 13): with a monitor port and a
+        # --log-file on the command, each member gets its OWN metrics
+        # file (<base>.r<i> — a shared file would interleave N
+        # processes' stanzas into an unreducible JSONL) and ONE
+        # telemetry/fleet.FleetCollector grows over all of them:
+        # merged quantiles, per-member breakdown, straggler detection
+        # across the gang. Supervisor ledger stamps (restart downtime,
+        # poison forensics) land in member 0's file, which stays the
+        # poison detector's evidence too.
+        self.member_log_files: list[str] = []
+        base = ledger_file or _argv_log_file(self.argv)
+        if monitor_port is not None and base:
+            self.member_log_files = [f"{base}.r{i}"
+                                     for i in range(self.n)]
+            base = self.member_log_files[0]
+        self.ledger_file = base
         self.heartbeat_files = []
         if hang_timeout is not None:
             assert "--heartbeat-file" not in self.argv, (
@@ -594,6 +619,34 @@ class GangSupervisor(Supervisor):
                 os.unlink(path)
             except OSError:
                 pass
+
+    def _start_monitor(self):
+        """Gang aggregation: one FleetCollector over every member's
+        metrics file, served on --monitor-port as the fleet's own
+        /status.json + /metrics (replica-labelled) — per-member
+        quantiles, merged fleet quantiles, straggler detection across
+        the gang. Returns (collector, server, collector): the
+        collector doubles as the stoppable tailer in run()'s
+        teardown."""
+        if self.monitor_port is None:
+            return None, None, None
+        if not self.member_log_files:
+            self.log("[elastic] --monitor-port needs the gang command "
+                     "to carry --log-file (the metrics JSONL to "
+                     "aggregate per member); monitoring disabled")
+            return None, None, None
+        from shallowspeed_tpu.telemetry.fleet import FleetCollector
+        from shallowspeed_tpu.telemetry.monitor import StatusServer
+
+        fc = FleetCollector(paths=self.member_log_files,
+                            labels=[f"r{i}" for i in range(self.n)],
+                            slos=self.slo)
+        srv = StatusServer(fc, port=self.monitor_port)
+        fc.start(poll=max(0.5, float(self.poll_interval)))
+        self.log(f"[elastic] fleet monitor: "
+                 f"{srv.url('/status.json')} (+ /metrics) over "
+                 f"{self.n} member file(s)")
+        return fc, srv, fc
 
     def _free_port(self) -> int:
         import socket
@@ -634,6 +687,9 @@ class GangSupervisor(Supervisor):
         try:
             for i in range(self.n):
                 argv = list(self.argv)
+                if self.member_log_files:
+                    argv = _set_argv_log_file(argv,
+                                              self.member_log_files[i])
                 if self.heartbeat_files:
                     # fresh clock AND fresh status per attempt (see
                     # Supervisor._run_once: a leftover 'dead' would
@@ -737,7 +793,12 @@ def main(argv=None) -> int:
     ap.add_argument("--monitor-port", type=int, default=None,
                     help="serve /status.json + /metrics for the whole "
                          "supervised history (tails the child's "
-                         "--log-file across restarts; 0 = free port)")
+                         "--log-file across restarts; 0 = free port). "
+                         "With --procs N the gang's --log-file is "
+                         "rewritten per member (<base>.r<i>) and one "
+                         "fleet collector (telemetry/fleet) serves "
+                         "merged quantiles, per-member breakdown, and "
+                         "straggler events across the gang")
     ap.add_argument("--slo", default="",
                     help="SLOs evaluated over the aggregated stream "
                          "(telemetry/monitor DSL, e.g. "

@@ -34,6 +34,15 @@ head-of-line behind the longest. This engine serves a STREAM:
   lines carry tick throughput and the live-blocks HBM sweep
   (`cache.paged_read_bytes_per_tick` — the serving generalization of
   `decode_read_bytes_per_token`).
+- **Per-request lifecycle tracing** (round 13). Every request carries
+  a phase timeline (submit -> queued -> admitted -> prefill chunk k ->
+  decoding -> preempted -> requeued -> finished): each transition
+  stamps a schema-v8 `"lifecycle"` event (with the ms spent in the
+  previous phase — `report.request_timeline` reconstructs the whole
+  accounting) and, under a live tracer, closes the previous phase as
+  a span on the request's own NAMED Chrome-trace track, cross-linked
+  to the engine tick counter. Fleet views resolve a burning SLO to
+  "which request, which phase, which replica" through this.
 
 Stream parity: sampling uses the SAME per-request key schedule as
 `generate()` — token i of a request with sampling seed s draws from
@@ -54,7 +63,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from shallowspeed_tpu import chaos
 from shallowspeed_tpu.models import generate as G
+from shallowspeed_tpu.telemetry.trace import tracer
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.models.kv_cache import masked_attention
 from shallowspeed_tpu.serving.cache import (SCRATCH_BLOCK, BlockAllocator,
@@ -62,6 +73,12 @@ from shallowspeed_tpu.serving.cache import (SCRATCH_BLOCK, BlockAllocator,
                                             gather_table, init_block_pool,
                                             paged_read_bytes_per_tick,
                                             param_read_bytes, write_rows)
+
+
+# finished-request timelines the engine retains in memory for
+# in-process consumers (bench phase accounting, tests); older entries
+# evict FIFO — the metrics JSONL carries the complete lifecycle stream
+TIMELINE_CAP = 1024
 
 
 def table_width(n_blocks: int, base: int) -> int:
@@ -214,7 +231,8 @@ class _Req:
     __slots__ = ("rid", "prompt", "max_new", "temp", "seed", "arrival",
                  "generated", "n_preempt", "phase", "slot", "ctx",
                  "table", "written", "admit_seq", "admit_t",
-                 "queued_at", "wait_s", "first_tok_t", "last_tok")
+                 "queued_at", "wait_s", "first_tok_t", "last_tok",
+                 "timeline", "track", "trace_t0")
 
     def __init__(self, rid, prompt, max_new, temp, seed, arrival):
         self.rid = rid
@@ -236,6 +254,11 @@ class _Req:
         self.wait_s = 0.0               # queue time over every stint
         self.first_tok_t = None
         self.last_tok = 0
+        # lifecycle tracing (schema v8): the host-side phase timeline,
+        # plus this request's named Chrome-trace track
+        self.timeline: list[dict] = []
+        self.track = None
+        self.trace_t0 = None
 
 
 class ServingEngine:
@@ -250,7 +273,8 @@ class ServingEngine:
                  max_slots: int = 4, prefill_chunk: int = 32,
                  table_bucket: int = 4, kv_quant: str = "",
                  top_k: int = 0, top_p: float = 0.0, metrics=None,
-                 log_every: int = 0, clock=time.time):
+                 log_every: int = 0, clock=time.time,
+                 lifecycle: bool = True, chaos_plan=None):
         self.params = params
         self.cfg = cfg
         self.block_size = int(block_size)
@@ -263,6 +287,17 @@ class ServingEngine:
         self.metrics = metrics
         self.log_every = int(log_every)
         self.clock = clock
+        # per-request lifecycle tracing (round 13): schema-v8
+        # "lifecycle" metrics events + one named Chrome-trace track
+        # per request. Costs one host dict per phase transition; only
+        # writes when a metrics sink / live tracer is attached.
+        self.lifecycle = bool(lifecycle)
+        # chaos plan consulted at every engine step (tick-indexed:
+        # stall sleeps, kill/nan poison ride the same hooks training
+        # uses). None falls back to the process-global plan, so
+        # serve.py --chaos and supervisor-exported drills just work;
+        # tests pass an explicit plan to fault ONE of N engines.
+        self.chaos_plan = chaos_plan
         self.pools = init_block_pool(cfg, n_blocks, block_size, kv_quant)
         self.alloc = BlockAllocator(n_blocks)
         self._p_bytes = param_read_bytes(params, cfg)  # constant term
@@ -270,6 +305,10 @@ class ServingEngine:
         self.queue: deque[_Req] = deque()
         self.results: dict[str, np.ndarray] = {}
         self.request_records: list[dict] = []
+        # finished requests' phase timelines (host dicts, kept for
+        # in-process consumers: bench's phase accounting, tests) —
+        # the JSONL "lifecycle" stream is the out-of-process surface
+        self.timelines: dict[str, list] = {}
         self.counters = {"submitted": 0, "finished": 0, "preempted": 0,
                          "ticks": 0, "prefill_chunks": 0,
                          "shed_toggles": 0}
@@ -318,9 +357,12 @@ class ServingEngine:
         if rid in self.results or any(
                 r.rid == rid for r in self._all_live()):
             raise ValueError(f"duplicate request id {rid!r}")
-        self.queue.append(_Req(rid, prompt, max_new, temperature, seed,
-                               self.clock()))
+        req = _Req(rid, prompt, max_new, temperature, seed,
+                   self.clock())
+        self.queue.append(req)
         self.counters["submitted"] += 1
+        self._lifecycle(req, "submit", tokens=int(tp))
+        self._lifecycle(req, "queued")
         return rid
 
     def poll(self, rid: str) -> dict:
@@ -344,6 +386,15 @@ class ServingEngine:
         decoding slot. Returns whether any work ran — decodes advance
         every step even while a long prompt prefills, which is the
         chunked-prefill no-stall contract."""
+        plan = self.chaos_plan if self.chaos_plan is not None \
+            else chaos.active()
+        if plan is not None:
+            # tick-indexed faults: a serving drill reuses the training
+            # hooks — stall sleeps here (and must surface as replica
+            # skew the fleet's straggler detector names), kill/nan
+            # poison the params like a training step would
+            plan.on_data_load(self.counters["ticks"])
+            plan.on_step(self.counters["ticks"], engine=self)
         did = self._admit()
         did = self._prefill_step() or did
         did = self._decode_step() or did
@@ -372,6 +423,44 @@ class ServingEngine:
         return {"decode_tick": int(_decode_tick._cache_size()),
                 "prefill_chunk": int(_prefill_chunk._cache_size()),
                 "sample": int(_sample_jit._cache_size())}
+
+    # ------------------------------------------------------- lifecycle
+
+    def _lifecycle(self, req, phase: str, **extra) -> None:
+        """One phase transition on `req`'s timeline: submit -> queued
+        -> admitted -> prefill (per chunk) -> decoding -> preempted ->
+        requeued -> ... -> finished. Stamps a schema-v8 "lifecycle"
+        metrics event (with the ms spent in the PREVIOUS phase, so
+        `report.request_timeline` reconstructs the whole span
+        accounting) and, when tracing is live, closes the previous
+        phase as an X span on the request's named trace track —
+        cross-linked to the engine tick spans via the tick counter."""
+        if not self.lifecycle:
+            return
+        now = self.clock()
+        prev = req.timeline[-1] if req.timeline else None
+        entry = {"phase": phase, "wall": now, **extra}
+        req.timeline.append(entry)
+        if self.metrics is not None:
+            rec = {"id": req.rid, "phase": phase,
+                   "seq": len(req.timeline) - 1,
+                   "tick": self.counters["ticks"], **extra}
+            if req.slot is not None:
+                rec["slot"] = req.slot
+            if prev is not None:
+                rec["prev"] = prev["phase"]
+                rec["ms_in_prev"] = round((now - prev["wall"]) * 1e3, 3)
+            self.metrics.log(event="lifecycle", **rec)
+        tr = tracer()
+        if tr.level != "off":
+            if req.track is None:
+                req.track = tr.track(f"request {req.rid}")
+            t1 = tr.now()
+            if prev is not None and req.trace_t0 is not None:
+                tr.complete(prev["phase"], req.trace_t0, t1,
+                            tid=req.track, id=req.rid,
+                            tick=self.counters["ticks"])
+            req.trace_t0 = t1
 
     # ------------------------------------------------------- scheduler
 
@@ -434,6 +523,7 @@ class ServingEngine:
             # on-device time between stints must not count as waiting)
             req.wait_s += req.admit_t - req.queued_at
             self.slots[slot] = req
+            self._lifecycle(req, "admitted", slot=slot)
             did = True
         return did
 
@@ -445,6 +535,8 @@ class ServingEngine:
         req = min(pre, key=lambda r: r.admit_seq)     # FIFO
         c = self.prefill_chunk
         n_tok = min(c, len(req.ctx) - req.written)
+        self._lifecycle(req, "prefill", chunk=req.written // c,
+                        tokens=int(n_tok))
         tokens = np.zeros((1, c), np.int32)
         tokens[0, :n_tok] = req.ctx[req.written:req.written + n_tok]
         w = table_width(len(req.table), self.table_bucket)
@@ -467,6 +559,7 @@ class ServingEngine:
                 np.asarray([len(req.generated)], np.int32),
                 top_k=self.top_k, top_p=self.top_p)
             req.phase = "decode"
+            self._lifecycle(req, "decoding")
             self._append_token(req, int(np.asarray(tok)[0]))
         return True
 
@@ -542,6 +635,8 @@ class ServingEngine:
         req.ctx = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)]) \
             if req.generated else req.prompt
+        self._lifecycle(req, "preempted",
+                        tokens=len(req.generated))
         self.slots[req.slot] = None
         req.slot = None
         req.phase = "queued"
@@ -549,6 +644,7 @@ class ServingEngine:
         req.n_preempt += 1
         self.counters["preempted"] += 1
         self.queue.appendleft(req)
+        self._lifecycle(req, "requeued")
 
     def _append_token(self, req, tok: int) -> None:
         req.generated.append(tok)
@@ -561,6 +657,14 @@ class ServingEngine:
     def _finish(self, req) -> None:
         self.alloc.free(req.table)
         req.table = []
+        self._lifecycle(req, "finished", tokens=len(req.generated))
+        if self.lifecycle:
+            # bounded retention (FIFO on dict insertion order): a
+            # long-running server must not grow one timeline per
+            # request forever; the JSONL stream is the full record
+            self.timelines[req.rid] = req.timeline
+            while len(self.timelines) > TIMELINE_CAP:
+                self.timelines.pop(next(iter(self.timelines)))
         self.slots[req.slot] = None
         self.results[req.rid] = np.asarray(req.generated, np.int32)
         self.counters["finished"] += 1

@@ -36,6 +36,12 @@ makes the *inside* of a step visible without xprof:
                  /metrics endpoints (--monitor-port), SLO burn-rate
                  alerts (--slo), anomaly flight recorder
                  (--flight-recorder), and the --live JSONL tailer.
+- `fleet`        fleet observability (round 13): `FleetCollector`
+                 aggregates N replicas (polled endpoints and/or
+                 tailed JSONLs) into merged quantiles, fleet SLO burn,
+                 per-replica breakdown, straggler detection (schema-v8
+                 "straggler" events), and a replica-labelled
+                 /status.json + /metrics of its own (--fleet).
 - `python -m shallowspeed_tpu.telemetry --validate f.jsonl ...`
                  schema gate for committed `docs_runs/*.jsonl` traces
                  (pre-commit hook); `--live f.jsonl [--once]` renders
@@ -77,6 +83,12 @@ _LAZY = {
     "Monitor": "monitor", "StatusServer": "monitor",
     "FlightRecorder": "monitor", "SloRule": "monitor",
     "parse_slos": "monitor", "FileTailer": "monitor",
+    "PortInUseError": "monitor", "prom_escape": "monitor",
+    # fleet observability (round 13): multi-replica collector,
+    # straggler detection, fleet endpoints
+    "FleetCollector": "fleet", "Replica": "fleet",
+    "format_fleet_status": "fleet",
+    "request_timeline": "report",
 }
 
 

@@ -8,6 +8,9 @@ run's goodput ledger, or watch a run live.
     python -m shallowspeed_tpu.telemetry --goodput run/metrics.jsonl
     python -m shallowspeed_tpu.telemetry --live run/metrics.jsonl
     python -m shallowspeed_tpu.telemetry --live f.jsonl --once
+    python -m shallowspeed_tpu.telemetry --fleet http://127.0.0.1:9100 \
+        http://127.0.0.1:9101 --port 9200
+    python -m shallowspeed_tpu.telemetry --fleet r0.jsonl r1.jsonl --once
 
 --validate and --regress are the pre-commit gates for committed
 `docs_runs/*.jsonl` snapshots and the `BENCH_r*.json` trajectory —
@@ -19,7 +22,11 @@ GROWING metrics JSONL and renders the same view the --monitor-port
 /status.json endpoint serves (streaming sketch quantiles, goodput so
 far, health, SLO burn rates with --slo) — live monitoring for runs
 started without an endpoint; --once renders the current state and
-exits (the pre-commit smoke mode).
+exits (the pre-commit smoke mode). --fleet aggregates N replicas
+(status URLs and/or metrics JSONL files) into one fleet view — merged
+quantiles, per-replica breakdown, fleet SLO burn, straggler detection
+— optionally re-served on --port as the fleet's own /status.json +
+/metrics (telemetry/fleet.py).
 """
 
 from __future__ import annotations
@@ -51,16 +58,38 @@ def main(argv=None) -> int:
                    help="tail a growing metrics JSONL and render the "
                         "live status view (the /status.json surface "
                         "for endpoint-less runs); Ctrl-C exits")
+    g.add_argument("--fleet", nargs="+", metavar="TARGET",
+                   help="aggregate N replicas into one fleet view: "
+                        "http(s) targets are polled /status.json + "
+                        "/sketches.json endpoints, anything else is a "
+                        "metrics JSONL to tail (telemetry/fleet.py) — "
+                        "merged quantiles, per-replica breakdown, "
+                        "fleet SLO burn, straggler detection")
     p.add_argument("--once", action="store_true",
-                   help="with --live: render the file's current state "
-                        "once and exit instead of following")
+                   help="with --live/--fleet: render the current "
+                        "state once and exit instead of following")
     p.add_argument("--slo", default="",
-                   help="with --live: evaluate these SLOs over the "
-                        "tailed stream (telemetry/monitor DSL, e.g. "
-                        "'ttft_p95_ms<500,availability>0.99')")
+                   help="with --live/--fleet: evaluate these SLOs "
+                        "over the (merged) stream (telemetry/monitor "
+                        "DSL, e.g. 'ttft_p95_ms<500,"
+                        "availability>0.99')")
     p.add_argument("--interval", type=float, default=2.0,
-                   help="with --live: seconds between renders")
+                   help="with --live/--fleet: seconds between renders")
+    p.add_argument("--port", type=int, default=None,
+                   help="with --fleet: ALSO serve the fleet's own "
+                        "/status.json + /metrics (replica-labelled) "
+                        "here (0 = free port)")
+    p.add_argument("--log-file", default=None,
+                   help="with --fleet: append straggler/alert events "
+                        "(schema v8) to this JSONL")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        from shallowspeed_tpu.telemetry.fleet import fleet_main
+
+        return fleet_main(args.fleet, slos=args.slo, once=args.once,
+                          interval=args.interval, port=args.port,
+                          log_file=args.log_file)
 
     if args.live:
         from shallowspeed_tpu.telemetry.monitor import live_main

@@ -324,6 +324,10 @@ def run_goodput(path) -> dict:
         # offline percentiles above (same rank rule; they may differ
         # only by the sketch's recorded rel_err)
         "monitor": _monitor_block(stanzas, request_recs),
+        # None without schema-v8 lifecycle events — aggregate
+        # per-phase request time (where did request latency go:
+        # queued vs prefill vs decoding vs preempted)
+        "lifecycle": _lifecycle_block(recs),
     }
 
 
@@ -331,6 +335,27 @@ def _request_block(request_recs) -> dict | None:
     from shallowspeed_tpu.telemetry.report import request_summary
 
     return request_summary(request_recs)
+
+
+def _lifecycle_block(recs) -> dict | None:
+    """Reduce schema-v8 lifecycle events to run-level phase
+    accounting: total ms the fleet's requests spent in each phase —
+    the 'which phase' half of the exemplar linkage (the fleet view
+    names which request/replica; this names where its time went)."""
+    if not any(r.get("event") == "lifecycle" for r in recs):
+        return None
+    from shallowspeed_tpu.telemetry.report import request_timeline
+
+    timelines = request_timeline(recs)
+    by_phase: dict[str, float] = {}
+    for tl in timelines.values():
+        for phase, ms in tl["by_phase_ms"].items():
+            by_phase[phase] = by_phase.get(phase, 0.0) + ms
+    return {"requests": len(timelines),
+            "complete": sum(1 for tl in timelines.values()
+                            if tl["complete"]),
+            "by_phase_ms": {k: round(v, 3)
+                            for k, v in sorted(by_phase.items())}}
 
 
 def _monitor_block(stanzas, request_recs) -> dict | None:
@@ -434,6 +459,13 @@ def format_report(rep: dict) -> str:
             f"{ms(req['tpot_ms_p95'])} ms  "
             f"tokens {req['tokens_in']}->{req['tokens_out']}  "
             f"preempted {req['preempted']}")
+    lc = rep.get("lifecycle")
+    if lc:
+        top = sorted(lc["by_phase_ms"].items(),
+                     key=lambda kv: -kv[1])[:4]
+        lines.append(
+            f"lifecycle ({lc['complete']}/{lc['requests']} complete): "
+            + "  ".join(f"{k} {v:.0f} ms" for k, v in top))
     mon = rep.get("monitor")
     if mon:
         qs = mon["quantiles"]

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import re
 import threading
 import time
@@ -62,6 +63,24 @@ from shallowspeed_tpu.telemetry.sketch import LogHistogram, MetricSketches
 # are the documented core set
 CORE_SKETCHES = ("step_ms", "ttft_ms", "tpot_ms", "tok_s",
                  "queue_depth", "free_blocks")
+
+# worst-K exemplars the monitor keeps per latency metric: the request
+# ids behind the tail quantile, so a fleet view can name WHICH request
+# (on which replica) is burning an SLO instead of just how badly
+EXEMPLAR_METRICS = ("ttft_ms", "tpot_ms")
+EXEMPLAR_K = 5
+
+
+class PortInUseError(OSError):
+    """--monitor-port names a port this process cannot bind."""
+
+
+def prom_escape(value) -> str:
+    """Prometheus text-exposition label-value escaping (backslash,
+    double quote, newline) — replica names are operator input and must
+    not be able to break the /metrics parse."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 # --------------------------------------------------------------- SLOs
@@ -142,6 +161,15 @@ class SloRule:
                 else value > self.threshold)
         self.last_value = float(value)
         self._events.append((now, 0 if good else count, count))
+        self._prune(now)
+
+    def record_counts(self, bad: int, total: int, now: float) -> None:
+        """Pre-judged observations (the fleet path: a merged sketch
+        delta yields bad/total counts against the threshold without
+        the raw values)."""
+        if total <= 0:
+            return
+        self._events.append((now, max(0, int(bad)), int(total)))
         self._prune(now)
 
     def record_down(self, seconds: float, now: float) -> None:
@@ -276,8 +304,13 @@ class Monitor:
     def __init__(self, slos: str = "", flight: int = 256,
                  flight_dir=None, rel_err: float = 0.01, emit=None,
                  derive_steps: bool = False, snapshot_every: int = 64,
-                 clock=time.time, slo_kw: dict | None = None):
+                 clock=time.time, slo_kw: dict | None = None,
+                 label: str | None = None):
+        self.label = label          # replica name in a fleet view
         self.sketches = MetricSketches(rel_err=rel_err)
+        # worst-K (value, request id) per latency metric — the
+        # exemplar linkage a fleet's worst-ttft bucket resolves to
+        self.exemplars: dict[str, list] = {}
         self.rules = parse_slos(slos, **(slo_kw or {}))
         self.flight = FlightRecorder(capacity=flight or 256,
                                      out_dir=flight_dir)
@@ -393,6 +426,8 @@ class Monitor:
             v = rec.get(field)
             if isinstance(v, (int, float)):
                 self.sketches.observe(name, v)
+                if name in EXEMPLAR_METRICS:
+                    self._note_exemplar(name, rec.get("id"), float(v))
                 for rule in self.rules:
                     if rule.sketch == name:
                         rule.record(float(v), now)
@@ -452,6 +487,16 @@ class Monitor:
             self.active_alerts.pop(rec.get("slo", "?"), None)
 
     # ------------------------------------------------------ internals
+
+    def _note_exemplar(self, name: str, rid, value: float) -> None:
+        """Keep the K worst (value, id) pairs for `name` — tail-quantile
+        forensics: the fleet view's worst-ttft bucket names these."""
+        if rid is None:
+            return
+        ex = self.exemplars.setdefault(name, [])
+        ex.append((value, str(rid)))
+        ex.sort(key=lambda p: -p[0])
+        del ex[EXEMPLAR_K:]
 
     def observe_locked(self, name, value, count=1):
         # observe() body without re-taking the RLock-guarded evaluate
@@ -569,11 +614,27 @@ class Monitor:
             return None
         return max(0.0, 1.0 - min(self._downtime_s, wall) / wall)
 
+    def sketch_payload(self) -> dict:
+        """The /sketches.json payload: the SERIALIZED (mergeable)
+        sketches, not just their quantile summaries — what a
+        FleetCollector polls so fleet quantiles are exact bucket
+        unions, the same payload a schema-v8 ``"monitor"`` event
+        carries."""
+        with self._lock:
+            return {"sketches": self.sketches.to_dict(),
+                    "rel_err": self.sketches.rel_err,
+                    "label": self.label,
+                    "exemplars": {name: [{"value": v, "id": rid}
+                                         for v, rid in ex]
+                                  for name, ex in self.exemplars.items()},
+                    "counters": dict(self.counters)}
+
     def status(self) -> dict:
         """The /status.json payload."""
         with self._lock:
             now = self._now()
             return {
+                "replica": self.label,
                 "wall": round(now, 3),
                 "uptime_s": (round(now - self._first_wall, 3)
                              if self._first_wall is not None else None),
@@ -588,6 +649,10 @@ class Monitor:
                 "slo": [r.status(now) for r in self.rules],
                 "alerts": sorted(self.active_alerts.values(),
                                  key=lambda a: a.get("slo", "")),
+                "worst": {name: [{"value": v, "id": rid}
+                                 for v, rid in ex]
+                          for name, ex in self.exemplars.items()}
+                or None,
                 "counters": dict(self.counters),
                 "flight_dumps": list(self.flight.dumps),
             }
@@ -640,7 +705,14 @@ class StatusServer:
     127.0.0.1:`port` (port 0 picks a free one — read `.port`). Runs on
     a daemon thread; `close()` shuts it down. No auth, loopback bind —
     an operator tunnel (ssh -L) is the expected transport, same as
-    jax's profiler server."""
+    jax's profiler server.
+
+    Duck-typed over `monitor`: anything with `status()`/`prometheus()`
+    serves (a `fleet.FleetCollector` plugs in unchanged). Objects that
+    also expose `sketch_payload()` get GET /sketches.json (the
+    serialized mergeable sketches a fleet poller needs), and objects
+    with `register_replica(payload)` get POST /register (a replica
+    announcing its own status URL to a fleet collector)."""
 
     def __init__(self, monitor: Monitor, port: int = 0,
                  host: str = "127.0.0.1"):
@@ -649,14 +721,26 @@ class StatusServer:
         mon = monitor
 
         class _Handler(BaseHTTPRequestHandler):
+            def _send(self, body: bytes, ctype: str) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
+                path = self.path.split("?")[0]
                 try:
-                    if self.path.split("?")[0] in ("/status.json",
-                                                   "/status", "/"):
+                    if path in ("/status.json", "/status", "/"):
                         body = json.dumps(mon.status(),
                                           default=str).encode()
                         ctype = "application/json"
-                    elif self.path.split("?")[0] == "/metrics":
+                    elif path == "/sketches.json" \
+                            and hasattr(mon, "sketch_payload"):
+                        body = json.dumps(mon.sketch_payload(),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    elif path == "/metrics":
                         body = mon.prometheus().encode()
                         ctype = ("text/plain; version=0.0.4; "
                                  "charset=utf-8")
@@ -666,16 +750,35 @@ class StatusServer:
                 except Exception as e:   # a status bug must not 500-loop
                     body = json.dumps({"error": repr(e)}).encode()
                     ctype = "application/json"
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send(body, ctype)
+
+            def do_POST(self):
+                if self.path.split("?")[0] != "/register" \
+                        or not hasattr(mon, "register_replica"):
+                    self.send_error(404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    out = mon.register_replica(payload)
+                except Exception as e:
+                    self.send_error(400, repr(e)[:120])
+                    return
+                self._send(json.dumps(out, default=str).encode(),
+                           "application/json")
 
             def log_message(self, *a):   # no per-request stderr spam
                 pass
 
-        self._srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        try:
+            self._srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        except OSError as e:
+            # a busy --monitor-port must fail with the port in the
+            # message, not a bare errno traceback three frames deep
+            raise PortInUseError(
+                f"cannot bind the monitor endpoint to {host}:{port} "
+                f"({e.strerror or e}); pick another --monitor-port "
+                f"(0 asks the OS for a free one)") from e
         self._srv.daemon_threads = True
         self.port = self._srv.server_address[1]
         self.host = host
@@ -712,7 +815,8 @@ def from_args(args, metrics, flight_dir=None):
         log_file = getattr(args, "log_file", "") or ""
         flight_dir = Path(log_file).parent if log_file else Path(".")
     mon = Monitor(slos=slo, flight=flight, flight_dir=flight_dir,
-                  emit=metrics.log if metrics is not None else None)
+                  emit=metrics.log if metrics is not None else None,
+                  label=getattr(args, "replica", None) or None)
     if metrics is not None:
         metrics.monitor = mon
     server = StatusServer(mon, port=port) if port is not None else None
@@ -732,10 +836,15 @@ def close_monitor(monitor, server) -> None:
 def iter_jsonl(path, pos: int = 0):
     """Parse records from `path` starting at byte `pos`; returns
     (records, new_pos). Tolerates a partial last line (the writer may
-    be mid-append) by not consuming it."""
+    be mid-append) by not consuming it. A file SHORTER than `pos`
+    means it was truncated or rotated under us — restart from byte 0
+    (re-reading a rotated file beats the old behavior of silently
+    reading nothing forever)."""
     recs = []
     try:
         with open(path, "rb") as f:
+            if os.fstat(f.fileno()).st_size < pos:
+                pos = 0
             f.seek(pos)
             data = f.read()
     except OSError:
@@ -770,8 +879,20 @@ class FileTailer(threading.Thread):
         # join machinery calls self._stop() internally)
         self._halt = threading.Event()
         self._pos = 0
+        self._ino: int | None = None
 
     def drain(self) -> int:
+        # rotation to an EQUAL-OR-LARGER file defeats iter_jsonl's
+        # size check — a changed inode means a different file, restart
+        # from byte 0 (shrinkage is caught either way)
+        try:
+            ino = os.stat(self.path).st_ino
+        except OSError:
+            ino = None
+        if ino is not None:
+            if self._ino is not None and ino != self._ino:
+                self._pos = 0
+            self._ino = ino
         recs, self._pos = iter_jsonl(self.path, self._pos)
         for rec in recs:
             self.monitor.note_line(rec)

@@ -83,6 +83,79 @@ def request_summary(recs) -> dict | None:
     }
 
 
+def request_timeline(source, rid: str | None = None) -> dict:
+    """Reconstruct per-request phase timelines from schema-v8
+    ``"lifecycle"`` events (`serving/engine.ServingEngine._lifecycle`:
+    submit -> queued -> admitted -> prefill chunk k -> decoding ->
+    preempted -> requeued -> finished).
+
+    `source` is a metrics-JSONL path or an iterable of parsed records;
+    `rid` filters to one request. Returns, per request id:
+
+        {"phases": [{"phase", "wall", "ms_in_prev", ...}, ...],
+         "by_phase_ms": {phase: total ms spent IN that phase},
+         "complete": started with submit and ended with finished,
+         "e2e_ms": submit -> finished wall span (None if incomplete)}
+
+    Time spent "in" a phase is attributed by the NEXT transition's
+    ms_in_prev (or wall delta when absent), so the sum of by_phase_ms
+    reconciles with e2e_ms up to stamp rounding — the fleet view's
+    worst-ttft exemplar resolves to which PHASE through this."""
+    if isinstance(source, (str, Path)):
+        recs = []
+        for line in Path(source).read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    else:
+        recs = list(source)
+    per: dict[str, list] = {}
+    for rec in recs:
+        if not isinstance(rec, dict) or rec.get("event") != "lifecycle":
+            continue
+        r = rec.get("id")
+        if not isinstance(r, str) or (rid is not None and r != rid):
+            continue
+        per.setdefault(r, []).append(rec)
+    out = {}
+    for r, events in per.items():
+        events.sort(key=lambda e: (e.get("seq", 0),
+                                   e.get("wall", 0.0)))
+        phases = []
+        by_phase: dict[str, float] = {}
+        for prev, cur in zip([None] + events, events):
+            entry = {k: cur[k] for k in
+                     ("phase", "wall", "ms_in_prev", "prev", "slot",
+                      "tick", "chunk", "tokens") if k in cur}
+            phases.append(entry)
+            if prev is None:
+                continue
+            ms = cur.get("ms_in_prev")
+            if not isinstance(ms, (int, float)):
+                w0, w1 = prev.get("wall"), cur.get("wall")
+                ms = ((w1 - w0) * 1e3
+                      if isinstance(w0, (int, float))
+                      and isinstance(w1, (int, float)) else 0.0)
+            name = cur.get("prev", prev.get("phase", "?"))
+            by_phase[name] = by_phase.get(name, 0.0) + float(ms)
+        complete = bool(phases) and phases[0]["phase"] == "submit" \
+            and phases[-1]["phase"] == "finished"
+        e2e = None
+        if complete and isinstance(phases[0].get("wall"), (int, float)) \
+                and isinstance(phases[-1].get("wall"), (int, float)):
+            e2e = round((phases[-1]["wall"] - phases[0]["wall"]) * 1e3,
+                        3)
+        out[r] = {"phases": phases,
+                  "by_phase_ms": {k: round(v, 3)
+                                  for k, v in sorted(by_phase.items())},
+                  "complete": complete,
+                  "e2e_ms": e2e}
+    return out
+
+
 def sds(tree):
     """Shape/dtype skeleton of a pytree (targets.py's `_sds` contract:
     safe to trace, can never alias live buffers)."""

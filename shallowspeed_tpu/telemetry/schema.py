@@ -51,11 +51,22 @@ from pathlib import Path
 # depth, free blocks), mergeable across processes/stanzas so the
 # supervisor and `--goodput` can recombine them into whole-run
 # quantiles — and `"alert"` events stamped by the SLO burn-rate
-# evaluator (--slo) at every state transition. The validator accepts
-# ALL dialects — every versioned field is optional, so committed
-# v1-v6 artifacts (no version stamp / no health / overlap / attrib /
-# wall / fault / request / monitor fields) keep validating unchanged.
-SCHEMA_VERSION = 7
+# evaluator (--slo) at every state transition; 8 = v7 plus the fleet
+# observability extension (round 13, `telemetry/fleet.py` +
+# `serving/engine.py` lifecycle tracing): `"straggler"` events — a
+# FleetCollector's sustained-divergence verdict on one replica's
+# per-metric quantiles vs the fleet median (RobustEWMA-scored),
+# naming the replica — and `"lifecycle"` events — one line per
+# serving-request phase transition (submit -> queued -> admitted ->
+# prefill chunk k -> decoding -> preempted -> requeued -> finished)
+# that `report.request_timeline` reconstructs into per-request
+# timelines; span lines additionally allow ph "M" (Chrome metadata:
+# the named per-request trace tracks). The validator accepts ALL
+# dialects — every versioned field is optional, so committed v1-v7
+# artifacts (no version stamp / no health / overlap / attrib / wall /
+# fault / request / monitor / straggler / lifecycle fields) keep
+# validating unchanged.
+SCHEMA_VERSION = 8
 
 _NUM = (int, float)
 
@@ -93,6 +104,14 @@ _METRIC_EVENTS = {
     # schema v7: SLO burn-rate state transition (fire / escalate /
     # resolve) from the --slo evaluator
     "alert": {"slo": str, "state": str},
+    # schema v8: a FleetCollector's straggler verdict — one replica's
+    # per-metric quantile sustained a divergence from the fleet median
+    # (telemetry/fleet.py); `state` is "firing" or "resolved"
+    "straggler": {"replica": str, "metric": str, "state": str},
+    # schema v8: one line per serving-request phase transition
+    # (serving/engine.ServingEngine._lifecycle) — the per-request span
+    # timeline `report.request_timeline` reconstructs
+    "lifecycle": {"id": str, "phase": str},
 }
 
 # optional typed fields on a "ledger" line (`fail_class`: the
@@ -115,6 +134,13 @@ _MONITOR_OPTIONAL = {"counters": dict, "rel_err": _NUM}
 _ALERT_OPTIONAL = {"severity": str, "metric": str, "burn_fast": _NUM,
                    "burn_slow": _NUM, "value": _NUM,
                    "threshold": _NUM, "step": int}
+
+# optional typed fields on the schema-v8 events
+_STRAGGLER_OPTIONAL = {"ratio": _NUM, "z": _NUM, "replica_q": _NUM,
+                       "fleet_q": _NUM, "q": int, "rounds": int}
+_LIFECYCLE_OPTIONAL = {"seq": int, "slot": int, "tick": int,
+                       "chunk": int, "tokens": int, "prev": str,
+                       "ms_in_prev": _NUM}
 
 # telemetry fields a step line MAY carry; when present they must type
 _STEP_TELEMETRY = {
@@ -140,7 +166,9 @@ _STEP_TELEMETRY = {
     "attrib_compute_scale": _NUM,
 }
 
-_SPAN_PH = {"X", "i", "C"}
+# "M" (schema v8): Chrome metadata events — the named per-request
+# lifecycle tracks (thread_name) the serving engine emits
+_SPAN_PH = {"X", "i", "C", "M"}
 
 
 def validate_line(rec: dict) -> list[str]:
@@ -195,8 +223,10 @@ def _validate_metric(rec: dict) -> list[str]:
                                  or isinstance(rec[field], bool)):
                 probs.append(f"request: field {field!r} is "
                              f"{type(rec[field]).__name__}")
-    if ev in ("monitor", "alert"):
-        opt = _MONITOR_OPTIONAL if ev == "monitor" else _ALERT_OPTIONAL
+    if ev in ("monitor", "alert", "straggler", "lifecycle"):
+        opt = {"monitor": _MONITOR_OPTIONAL, "alert": _ALERT_OPTIONAL,
+               "straggler": _STRAGGLER_OPTIONAL,
+               "lifecycle": _LIFECYCLE_OPTIONAL}[ev]
         for field, typ in opt.items():
             if field in rec and (not isinstance(rec[field], typ)
                                  or isinstance(rec[field], bool)):

@@ -97,6 +97,19 @@ class LogHistogram:
     def mean(self) -> float | None:
         return self.total / self.n if self.n else None
 
+    def count_above(self, x: float) -> int:
+        """Observations whose value exceeds `x`, at bucket resolution:
+        a bucket counts by its representative value, so the answer is
+        exact except for samples within `rel_err` of `x` — the bound
+        the fleet SLO evaluator inherits when it scores a merged
+        stream against a threshold without the raw values."""
+        x = float(x)
+        n = self.n_zero if x < 0.0 else 0
+        for i, c in self.buckets.items():
+            if self._bucket_value(i) > x:
+                n += c
+        return n
+
     def summary(self, qs=(50, 95, 99)) -> dict:
         """The /status.json block for this sketch."""
         out = {"count": self.n}

@@ -134,6 +134,10 @@ class Tracer:
         self._events: deque = deque(maxlen=_BUF_CAP)
         self._seq = 0                    # total events ever emitted
         self._counters: dict[str, float] = {}
+        # named tracks (round 13): explicit Chrome tids above the span
+        # nesting depths, one per serving request — depth-tids stay
+        # single digits, so the offset can never collide
+        self._next_tid = 1000
         self._lock = threading.Lock()
         self._jsonl = None
         # span-event subscribers (round 12): the live monitor's
@@ -171,6 +175,44 @@ class Tracer:
         self._emit({"name": name, "ph": "i",
                     "ts": round((self._clock() - self._epoch) * 1e6, 1),
                     "args": attrs})
+
+    def now(self) -> float:
+        """This tracer's clock (perf_counter by default) — callers that
+        record phase boundaries host-side and export them later via
+        `complete` must stamp on THIS clock, not time.time."""
+        return self._clock()
+
+    def track(self, name: str) -> int:
+        """Allocate a named Chrome-trace track and return its tid —
+        one per serving request, so each request renders as its own
+        named row in Perfetto next to the engine tick spans. Emits the
+        thread_name metadata line; returns 0 (the shared depth track)
+        when tracing is off."""
+        if self.level == "off":
+            return 0
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+        self._emit({"name": "thread_name", "ph": "M", "ts": 0.0,
+                    "tid": tid, "args": {"name": name}})
+        return tid
+
+    def complete(self, name: str, t0: float, t1: float,
+                 tid: int | None = None, **attrs) -> None:
+        """Emit an already-closed span (ph "X") directly — the
+        lifecycle path, where phase boundaries are recorded host-side
+        as they happen and exported when the phase ENDS. `t0`/`t1` are
+        on this tracer's clock (`now()`); `tid` targets a named track
+        from `track()`."""
+        if self.level == "off":
+            return
+        ev = {"name": name, "ph": "X",
+              "ts": round((t0 - self._epoch) * 1e6, 1),
+              "dur": round(max(0.0, t1 - t0) * 1e6, 1),
+              "args": attrs}
+        if tid is not None:
+            ev["tid"] = tid
+        self._emit(ev)
 
     def counter(self, name: str, value) -> None:
         """Monotonic/telemetry counter sample (recompiles, HBM bytes)."""
@@ -236,8 +278,10 @@ class Tracer:
 
     @staticmethod
     def _chrome_event(e: dict) -> dict:
+        # explicit tid (a named lifecycle track) wins over the span
+        # nesting depth
         ev = {"name": e["name"], "ph": e["ph"], "ts": e["ts"],
-              "pid": 0, "tid": e.get("depth", 0),
+              "pid": 0, "tid": e.get("tid", e.get("depth", 0)),
               "args": e.get("args", {})}
         if e["ph"] == "X":
             ev["dur"] = e["dur"]

@@ -1,0 +1,566 @@
+"""Fleet observability (round 13): multi-replica collector, straggler
+detection, per-request lifecycle tracing.
+
+Acceptance pins:
+- fleet quantile parity: a 3-replica scripted serving run's fleet
+  /status.json quantiles match the POOLED offline --goodput reduction
+  within the recorded rel_err (`test_fleet_quantile_parity_3_replicas`
+  — the fleet generalization of the PR-8 live/offline canary);
+- a seeded `stall` chaos fault on exactly one of three replicas
+  raises a schema-v8 "straggler" event naming that replica, while the
+  stalled request's lifecycle timeline reconstructs its phases
+  end-to-end (`test_stall_chaos_on_one_replica_names_straggler`);
+- lifecycle events validate schema v8 and render as one named track
+  per request in the Chrome trace, cross-linked to engine ticks.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.telemetry.fleet import (FleetCollector, Replica,
+                                              fleet_main,
+                                              format_fleet_status)
+from shallowspeed_tpu.telemetry.monitor import Monitor, StatusServer
+from shallowspeed_tpu.telemetry.report import (percentile,
+                                               request_timeline)
+from shallowspeed_tpu.telemetry.schema import (validate_file,
+                                               validate_line)
+from shallowspeed_tpu.telemetry.sketch import MetricSketches
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+# ------------------------------------------------------- scripted fixtures
+
+
+def _write_replica_jsonl(path, replica, ttfts, step_ms=None, wall0=100.0):
+    """A minimal schema-valid metrics file for one replica: run_start
+    (with the replica label), one request line per ttft, optional
+    step lines."""
+    wall = wall0
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "run_start", "schema_version": 8,
+                            "replica": replica, "wall": wall}) + "\n")
+        for s, ms in enumerate(step_ms or []):
+            wall += ms / 1e3
+            f.write(json.dumps({"event": "step", "step": s,
+                                "loss": 1.0, "tokens_per_sec": 50.0,
+                                "wall": round(wall, 4)}) + "\n")
+        for i, t in enumerate(ttfts):
+            wall += 0.01
+            f.write(json.dumps({"event": "request",
+                                "id": f"{replica}-q{i}",
+                                "ttft_ms": float(t), "tpot_ms": 3.0,
+                                "tokens_in": 4, "tokens_out": 4,
+                                "wall": round(wall, 4)}) + "\n")
+    return path
+
+
+def _serve_replica(params, cfg, path, replica, n_req=5, seed=0,
+                   chaos_plan=None, **engine_kw):
+    """One scripted in-process serving run writing `path`."""
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.serving import ServingEngine
+
+    metrics = MetricsLogger(path, kind="serve", replica=replica)
+    eng = ServingEngine(params, cfg, metrics=metrics, log_every=4,
+                        chaos_plan=chaos_plan, **engine_kw)
+    rng = np.random.default_rng(seed)
+    for i in range(n_req):
+        eng.submit(rng.integers(0, cfg.vocab, 6 + 2 * i)
+                   .astype(np.int32), 4 + i, rid=f"{replica}-q{i}")
+    eng.run()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def serving_setup():
+    """Shared params + a jit warmup run, so replica timing in the
+    straggler test reflects steady-state ticks, not which engine paid
+    the one-time compile."""
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(vocab=48, d_model=24, n_heads=2,
+                              n_layers=2, max_seq=96)
+    params = jax.device_put(T.init(cfg, seed=1))
+    kw = dict(n_blocks=48, block_size=8, max_slots=2, prefill_chunk=16)
+    _serve_replica(params, cfg, None, "warmup", n_req=5, **kw)
+    return params, cfg, kw
+
+
+# ------------------------------------------------------------ collector
+
+
+def test_fleet_merges_file_replicas_and_labels(tmp_path):
+    a = _write_replica_jsonl(tmp_path / "a.jsonl", "alpha",
+                             [10, 20, 30, 40])
+    b = _write_replica_jsonl(tmp_path / "b.jsonl", "beta",
+                             [50, 60, 70, 80])
+    fc = FleetCollector(paths=[a, b])
+    st = fc.refresh()
+    # labels learned from the run_start stamps, not the file names
+    assert set(st["replicas"]) == {"alpha", "beta"}
+    assert st["fleet"]["alive"] == 2
+    merged = st["fleet"]["sketches"]["ttft_ms"]
+    assert merged["count"] == 8
+    # exact bucket union: the pooled nearest-rank percentile within
+    # the sketch's rel_err
+    exact = percentile([10, 20, 30, 40, 50, 60, 70, 80], 50)
+    assert abs(merged["p50"] - exact) <= st["fleet"]["rel_err"] * exact
+    # worst-ttft exemplars name request id AND replica — the one-hop
+    # SLO-burn-to-request linkage
+    worst = st["worst_ttft"]
+    assert worst[0]["replica"] == "beta" and worst[0]["id"] == "beta-q3"
+    # per-replica breakdown carries per-metric quantiles
+    assert st["replicas"]["alpha"]["quantiles"]["ttft_ms"]["count"] == 4
+
+
+def test_fleet_url_mode_and_registration(tmp_path):
+    mon_a, mon_b = Monitor(label="a", flight=0), Monitor(flight=0)
+    for i in range(10):
+        mon_a.note_line({"event": "request", "id": f"a{i}",
+                         "ttft_ms": 10.0 + i, "tokens_in": 1,
+                         "tokens_out": 2, "wall": 100.0 + i})
+        mon_b.note_line({"event": "request", "id": f"b{i}",
+                         "ttft_ms": 200.0 + i, "tokens_in": 1,
+                         "tokens_out": 2, "wall": 100.0 + i})
+    srv_a = StatusServer(mon_a, port=0)
+    srv_b = StatusServer(mon_b, port=0)
+    try:
+        fc = FleetCollector(urls=[srv_a.url("/status.json")])
+        # replica b self-registers over HTTP, like serve.py
+        # --fleet-register does; the fleet endpoint serves the merged
+        # view
+        fleet_srv = StatusServer(fc, port=0)
+        try:
+            body = json.dumps({"url": srv_b.url("/status.json"),
+                               "name": "b"}).encode()
+            resp = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    fleet_srv.url("/register"), data=body,
+                    headers={"Content-Type": "application/json"}),
+                timeout=10).read())
+            assert resp == {"ok": True, "replicas": 2}
+            # re-registration refreshes, never duplicates
+            urllib.request.urlopen(urllib.request.Request(
+                fleet_srv.url("/register"), data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=10).read()
+            assert len(fc.replicas) == 2
+            fc.refresh()
+            st = json.loads(urllib.request.urlopen(
+                fleet_srv.url("/status.json"), timeout=10).read())
+            assert st["fleet"]["sketches"]["ttft_ms"]["count"] == 20
+            assert set(st["replicas"]) == {"a", "b"}
+            prom = urllib.request.urlopen(
+                fleet_srv.url("/metrics"), timeout=10).read().decode()
+            assert 'shallowspeed_ttft_ms{replica="a",quantile="0.95"}' \
+                in prom
+            assert 'shallowspeed_fleet_up{replica="b"} 1' in prom
+        finally:
+            fleet_srv.close()
+    finally:
+        srv_a.close()
+        srv_b.close()
+
+
+def test_fleet_slo_burns_over_merged_stream(tmp_path):
+    """The fleet rule fires on the MERGED stream: each replica alone
+    is under min_count, together they burn."""
+    clock = [1000.0]
+    fc = FleetCollector(
+        paths=[_write_replica_jsonl(tmp_path / "a.jsonl", "a",
+                                    [500.0] * 6),
+               _write_replica_jsonl(tmp_path / "b.jsonl", "b",
+                                    [600.0] * 6)],
+        slos="ttft_p50_ms<100", clock=lambda: clock[0],
+        slo_kw=dict(fast_s=10, slow_s=60, min_count=10))
+    st = fc.refresh()
+    assert st["alerts"] and st["alerts"][0]["state"] == "firing"
+    assert fc.events and fc.events[-1]["event"] == "alert"
+    # deltas, not cumulative re-feeds: a second refresh with no new
+    # lines must not re-count the same observations
+    clock[0] += 1
+    rule = fc.rules[0]
+    before = sum(c for _, _, c in rule._events)
+    fc.refresh()
+    assert sum(c for _, _, c in rule._events) == before
+
+
+def test_fleet_straggler_fires_and_resolves(tmp_path):
+    """Scripted skew: replica c's ttft p50 is ~6x the fleet median →
+    sustained divergence fires a schema-v8 "straggler" naming c, a
+    flight dump lands, and recovery resolves it."""
+    reps = {"a": [20.0] * 10, "b": [22.0] * 10, "c": [130.0] * 10}
+    paths = [_write_replica_jsonl(tmp_path / f"{r}.jsonl", r, v)
+             for r, v in reps.items()]
+    fc = FleetCollector(paths=paths,
+                        straggler_metrics=("ttft_ms",),
+                        straggler_patience=2, straggler_min_count=4,
+                        flight=8, flight_dir=tmp_path)
+    fc.refresh()
+    assert not fc.stragglers           # patience: one round is a blip
+    st = fc.refresh()
+    assert st["stragglers"], st
+    s = st["stragglers"][0]
+    assert s["replica"] == "c" and s["metric"] == "ttft_ms"
+    assert s["state"] == "firing" and s["ratio"] > 2.0
+    rec = next(e for e in fc.events if e["event"] == "straggler")
+    assert validate_line(rec) == []
+    assert fc.flight.dumps, "straggler must dump the flight ring"
+    dump = json.loads(Path(fc.flight.dumps[0]).read_text())
+    assert dump["reason"] == "straggler:c:ttft_ms"
+    # replica-labelled straggler gauge on /metrics
+    assert 'shallowspeed_fleet_straggler{replica="c",' \
+           'metric="ttft_ms"} 1' in fc.prometheus()
+    # recovery: c's distribution comes back to the pack -> resolved
+    _write_replica_jsonl(tmp_path / "c.jsonl", "c", [21.0] * 300)
+    fc.refresh()
+    assert not fc.stragglers
+    assert fc.events[-1]["event"] == "straggler"
+    assert fc.events[-1]["state"] == "resolved"
+
+
+def test_fleet_mixed_rel_err_reduces_largest_group(tmp_path):
+    # mixed-precision producers reduce to the largest same-rel_err
+    # group, like the goodput monitor block
+    a = _write_replica_jsonl(tmp_path / "a.jsonl", "a", [10.0] * 4)
+    b = _write_replica_jsonl(tmp_path / "b.jsonl", "b", [10.0] * 4)
+    fc = FleetCollector(paths=[a, b])
+    fc.replicas[1]._mon.sketches = MetricSketches(rel_err=0.05)
+    st = fc.refresh()
+    assert st["fleet"]["sketches"]["ttft_ms"]["count"] == 4
+    assert st["fleet"]["skipped_mixed_rel_err"] == 1
+
+
+def test_fleet_colliding_replica_names_stay_distinct(tmp_path):
+    """Two unlabelled replicas whose files share a basename must not
+    collapse into one name-keyed entry: internal state is keyed by
+    uid, display names get '#uid' suffixed on collision, and the
+    straggler detector still sees every replica."""
+    (tmp_path / "runA").mkdir()
+    (tmp_path / "runB").mkdir()
+    a = tmp_path / "runA" / "metrics.jsonl"
+    b = tmp_path / "runB" / "metrics.jsonl"
+    # no run_start 'replica' label in either file -> both stems are
+    # 'metrics'
+    for path, ttfts in ((a, [20.0] * 10), (b, [200.0] * 10)):
+        with open(path, "w") as f:
+            for i, t in enumerate(ttfts):
+                f.write(json.dumps({"event": "request",
+                                    "id": f"q{i}", "ttft_ms": t,
+                                    "tokens_in": 1, "tokens_out": 1,
+                                    "wall": 100.0 + i}) + "\n")
+    fc = FleetCollector(paths=[a, b],
+                        straggler_metrics=("ttft_ms",),
+                        straggler_patience=1, straggler_min_count=4,
+                        slos="ttft_p50_ms<100",
+                        slo_kw=dict(fast_s=10, slow_s=60,
+                                    min_count=5))
+    st = fc.refresh()
+    assert set(st["replicas"]) == {"metrics", "metrics#1"}
+    assert st["fleet"]["sketches"]["ttft_ms"]["count"] == 20
+    # straggler detection ran across BOTH replicas (not collapsed)
+    assert st["stragglers"] and \
+        st["stragglers"][0]["replica"] == "metrics#1"
+    assert 'replica="metrics#1"' in fc.prometheus()
+    # SLO deltas: exactly 10 bad / 20 total fed once, not corrupted
+    # by a shared key
+    assert sum(b for _, b, _ in fc.rules[0]._events) == 10
+    assert sum(c for _, _, c in fc.rules[0]._events) == 20
+
+
+def test_fleet_unreachable_endpoint_feeds_availability(tmp_path):
+    clock = [500.0]
+    fc = FleetCollector(urls=["http://127.0.0.1:9"],  # discard port
+                        slos="availability>0.9",
+                        clock=lambda: clock[0], timeout=0.2,
+                        slo_kw=dict(fast_s=10, slow_s=100,
+                                    warn_burn=2.0, critical_burn=50.0))
+    st = fc.refresh()                  # baseline: no dt yet
+    assert st["fleet"]["alive"] == 0
+    assert st["replicas"]["http://127.0.0.1:9"]["error"]
+    clock[0] += 30.0
+    fc.refresh()            # 30s unreachable -> downtime burns BOTH
+    assert fc.rules[0].burn(10, clock[0]) > 2.0   # windows (fires)
+    assert fc.active_alerts
+
+
+def test_fleet_main_once_over_files(tmp_path, capsys):
+    _write_replica_jsonl(tmp_path / "a.jsonl", "a", [10.0] * 4)
+    rc = fleet_main([str(tmp_path / "a.jsonl")], once=True)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "1/1 replicas alive" in out and "ttft_ms" in out
+    assert fleet_main([str(tmp_path / "missing.jsonl")],
+                      once=True) == 1
+
+
+# ------------------------------------------------- lifecycle tracing
+
+
+def test_lifecycle_events_validate_and_reconstruct(tmp_path,
+                                                   serving_setup):
+    params, cfg, kw = serving_setup
+    path = tmp_path / "serve.jsonl"
+    eng = _serve_replica(params, cfg, path, "solo", n_req=4, **kw)
+    assert validate_file(path) == []
+    timelines = request_timeline(path)
+    assert set(timelines) == set(eng.results)
+    for rid, tl in timelines.items():
+        phases = [p["phase"] for p in tl["phases"]]
+        assert phases[0] == "submit" and phases[1] == "queued"
+        assert "admitted" in phases and "decoding" in phases
+        assert phases[-1] == "finished" and tl["complete"]
+        # span accounting reconciles: phase times sum to the e2e wall
+        assert sum(tl["by_phase_ms"].values()) == pytest.approx(
+            tl["e2e_ms"], abs=2.0)
+    # a long prompt prefills in multiple chunks, each stamped
+    long = request_timeline(path, rid="solo-q3")["solo-q3"]
+    chunks = [p for p in long["phases"] if p["phase"] == "prefill"]
+    assert len(chunks) >= 1 and chunks[0]["chunk"] == 0
+
+
+def test_lifecycle_preemption_phases(serving_setup, tmp_path):
+    """A pool small enough to force eviction shows the preempted ->
+    requeued -> re-prefill arc in the victim's timeline."""
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(vocab=32, d_model=16, n_heads=2,
+                              n_layers=1, max_seq=64)
+    params = jax.device_put(T.init(cfg, seed=0))
+    path = tmp_path / "pre.jsonl"
+    eng = _serve_replica(params, cfg, path, "pre", n_req=3, seed=3,
+                         n_blocks=8, block_size=4, max_slots=3,
+                         prefill_chunk=8)
+    assert eng.counters["preempted"] >= 1
+    timelines = request_timeline(path)
+    victim = next(tl for tl in timelines.values()
+                  if "preempted" in [p["phase"] for p in tl["phases"]])
+    phases = [p["phase"] for p in victim["phases"]]
+    i = phases.index("preempted")
+    assert phases[i + 1] == "requeued"
+    assert "prefill" in phases[i + 2:], phases  # re-prefills its ctx
+    assert phases[-1] == "finished" and victim["complete"]
+
+
+def test_lifecycle_named_tracks_in_chrome_trace(tmp_path,
+                                                serving_setup):
+    params, cfg, kw = serving_setup
+    from shallowspeed_tpu.telemetry import trace
+
+    tr = trace.configure(trace_dir=tmp_path / "tr", level="steps")
+    try:
+        _serve_replica(params, cfg, None, "tr", n_req=2, **kw)
+        chrome = tr.chrome_trace()["traceEvents"]
+    finally:
+        trace.configure(level="off")
+    names = {e["args"].get("name"): e["tid"] for e in chrome
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "request tr-q0" in names and "request tr-q1" in names
+    tid = names["request tr-q0"]
+    spans = [e for e in chrome if e["ph"] == "X" and e["tid"] == tid]
+    got = [e["name"] for e in spans]
+    # each closed phase is one span on the request's own track,
+    # cross-linked to the engine tick counter
+    for phase in ("submit", "queued", "admitted", "prefill",
+                  "decoding"):
+        assert phase in got, (phase, got)
+    assert all(e["args"].get("id") == "tr-q0" for e in spans)
+    assert any(isinstance(e["args"].get("tick"), int) for e in spans)
+    # spans.jsonl validates (ph "M" is schema-v8-legal)
+    assert validate_file(tmp_path / "tr" / "spans.jsonl") == []
+
+
+def test_lifecycle_off_emits_nothing(tmp_path, serving_setup):
+    params, cfg, kw = serving_setup
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.serving import ServingEngine
+
+    path = tmp_path / "off.jsonl"
+    eng = ServingEngine(params, cfg,
+                        metrics=MetricsLogger(path, kind="serve"),
+                        lifecycle=False, **kw)
+    eng.submit(np.arange(6, dtype=np.int32) % cfg.vocab, 4, rid="x")
+    eng.run()
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert not any(r.get("event") == "lifecycle" for r in recs)
+    assert set(eng.results) == {"x"}     # serving itself unaffected
+
+
+# ---------------------------------------- acceptance: quantile parity
+
+
+def test_fleet_quantile_parity_3_replicas(tmp_path, serving_setup):
+    """Acceptance: a 3-replica scripted serving run's fleet
+    /status.json quantiles match the POOLED offline --goodput
+    reduction within the recorded rel_err (the fleet generalization
+    of the PR-8 live/offline parity canary)."""
+    from shallowspeed_tpu.telemetry.goodput import run_goodput
+
+    params, cfg, kw = serving_setup
+    paths = []
+    for r in range(3):
+        p = tmp_path / f"rep{r}.jsonl"
+        paths.append(p)
+        _serve_replica(params, cfg, p, f"rep{r}", n_req=4, seed=10 + r,
+                       **kw)
+    fc = FleetCollector(paths=paths)
+    srv = StatusServer(fc, port=0)
+    try:
+        fc.refresh()
+        st = json.loads(urllib.request.urlopen(
+            srv.url("/status.json"), timeout=10).read())
+    finally:
+        srv.close()
+    assert st["fleet"]["alive"] == 3
+    # pooled offline reduction: one file with all three stanzas
+    pooled = tmp_path / "pooled.jsonl"
+    pooled.write_text("".join(p.read_text() for p in paths))
+    rep = run_goodput(pooled)
+    off = rep["requests"]
+    assert off["n_requests"] == 12
+    rel = st["fleet"]["rel_err"]
+    for name in ("ttft_ms", "tpot_ms"):
+        for q in (50, 95):
+            live = st["fleet"]["sketches"][name][f"p{q}"]
+            exact = off[f"{name}_p{q}"]
+            # same within_bound contract as the goodput monitor-block
+            # parity: sketch vs exact within the recorded rel_err
+            # (+1e-3 for the report's ms rounding)
+            assert abs(live - exact) <= rel * abs(exact) + 1e-3, (
+                name, q, live, exact)
+    # the pooled reducer's own merged-monitor cross-check agrees with
+    # the per-replica snapshots the engines streamed
+    assert rep["monitor"] is None or all(
+        v["within_bound"] for v in
+        rep["monitor"].get("parity", {}).values())
+    # the reducer's schema-v8 lifecycle block accounts every request's
+    # phase time across the pooled fleet
+    lc = rep["lifecycle"]
+    assert lc["requests"] == 12 and lc["complete"] == 12
+    assert {"queued", "decoding"} <= set(lc["by_phase_ms"])
+
+
+# --------------------------- acceptance: stall chaos -> straggler
+
+
+def test_stall_chaos_on_one_replica_names_straggler(tmp_path,
+                                                    serving_setup):
+    """Acceptance: a seeded `stall` chaos fault on exactly ONE of
+    three replicas raises a "straggler" event naming that replica,
+    and the stalled request's lifecycle timeline reconstructs its
+    phases end-to-end."""
+    from shallowspeed_tpu.chaos import FaultPlan
+
+    params, cfg, kw = serving_setup
+    paths = []
+    for r in range(3):
+        p = tmp_path / f"rep{r}.jsonl"
+        paths.append(p)
+        plan = (FaultPlan.parse("stall@1:1.0", log_file=str(p))
+                if r == 1 else None)
+        _serve_replica(params, cfg, p, f"rep{r}", n_req=4, seed=20 + r,
+                       chaos_plan=plan, **kw)
+    # the fault fired on rep1 and stamped its forensic record there
+    recs = [json.loads(l) for l in paths[1].read_text().splitlines()]
+    stalls = [r for r in recs if r.get("event") == "fault"
+              and r.get("kind") == "stall"]
+    assert len(stalls) == 1 and stalls[0]["seconds"] == 1.0
+    assert not any(r.get("event") == "fault" for r in
+                   (json.loads(l)
+                    for p in (paths[0], paths[2])
+                    for l in p.read_text().splitlines()))
+    fc = FleetCollector(paths=paths,
+                        straggler_metrics=("ttft_ms",),
+                        straggler_patience=2, straggler_min_count=4,
+                        flight=16, flight_dir=tmp_path)
+    fc.refresh()
+    st = fc.refresh()                 # sustained for `patience` rounds
+    assert st["stragglers"], st["replicas"]
+    s = st["stragglers"][0]
+    assert s["replica"] == "rep1" and s["state"] == "firing"
+    assert validate_line(
+        next(e for e in fc.events if e["event"] == "straggler")) == []
+    # the 1s stall dwarfs the healthy replicas' ttft
+    assert s["ratio"] >= 2.0, s
+    # end-to-end lifecycle reconstruction of a stalled request: every
+    # phase from submit to finished, with the stall's second showing
+    # up in the phase the request was in when the engine slept
+    timelines = request_timeline(paths[1])
+    worst_rid = st["worst_ttft"][0]["id"]
+    assert st["worst_ttft"][0]["replica"] == "rep1"
+    tl = timelines[worst_rid]
+    assert tl["complete"] and tl["e2e_ms"] >= 1000.0
+    assert sum(tl["by_phase_ms"].values()) == pytest.approx(
+        tl["e2e_ms"], abs=2.0)
+    assert max(tl["by_phase_ms"].values()) >= 900.0
+
+
+# ----------------------------------------------- gang supervisor wiring
+
+
+def test_gang_supervisor_grows_fleet_collector(tmp_path):
+    from shallowspeed_tpu.elastic import (GangSupervisor,
+                                          _set_argv_log_file)
+
+    assert _set_argv_log_file(["x", "--log-file", "a.jsonl"], "b")[2] \
+        == "b"
+    assert _set_argv_log_file(["x", "--log-file=a.jsonl"], "b")[1] \
+        == "--log-file=b"
+    assert _set_argv_log_file(["x"], "b")[-2:] == ["--log-file", "b"]
+
+    base = str(tmp_path / "gang.jsonl")
+    sup = GangSupervisor(["prog", "--log-file", base], n_procs=3,
+                         monitor_port=0)
+    # per-member files: stanzas never interleave; member 0's file is
+    # the supervisor's ledger/poison evidence
+    assert sup.member_log_files == [f"{base}.r{i}" for i in range(3)]
+    assert sup.ledger_file == f"{base}.r0"
+    for i, f in enumerate(sup.member_log_files):
+        _write_replica_jsonl(f, f"m{i}", [10.0 + i] * 3)
+    fc, srv, tailer = sup._start_monitor()
+    try:
+        assert isinstance(fc, FleetCollector) and tailer is fc
+        st = fc.refresh()
+        assert st["fleet"]["sketches"]["ttft_ms"]["count"] == 9
+        assert set(st["replicas"]) == {"r0", "r1", "r2"}
+    finally:
+        fc.stop()
+        srv.close()
+    # without --log-file there is nothing to aggregate
+    sup2 = GangSupervisor(["prog"], n_procs=2, monitor_port=0)
+    assert sup2._start_monitor() == (None, None, None)
+
+
+def test_replica_name_fallback_is_file_stem(tmp_path):
+    p = tmp_path / "west-7.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"event": "step", "step": 0, "loss": 1.0,
+                            "tokens_per_sec": 5.0, "wall": 1.0}) + "\n")
+    rep = Replica(None, path=p)
+    rep.refresh(0.0)
+    assert rep.name == "west-7"
+
+
+def test_format_fleet_status_renders_stragglers(tmp_path):
+    reps = {"a": [20.0] * 10, "b": [22.0] * 10, "c": [130.0] * 10}
+    fc = FleetCollector(
+        paths=[_write_replica_jsonl(tmp_path / f"{r}.jsonl", r, v)
+               for r, v in reps.items()],
+        straggler_metrics=("ttft_ms",), straggler_patience=1,
+        straggler_min_count=4)
+    out = format_fleet_status(fc.refresh())
+    assert "3/3 replicas alive" in out
+    assert "STRAGGLER c ttft_ms" in out
+    assert "worst ttft" in out and "@ c" in out

@@ -92,6 +92,67 @@ def test_sketch_zero_negative_and_empty():
     assert sk.n == 5
 
 
+def test_sketch_merge_with_empty_preserves_quantiles():
+    """Satellite: merging an empty sketch (either direction) is the
+    identity — quantile parity preserved."""
+    full = LogHistogram(0.01)
+    vals = [1.5, 7.0, 42.0, 42.0, 999.0]
+    for v in vals:
+        full.add(v)
+    before = [full.quantile(q) for q in (50, 95, 99)]
+    full.merge(LogHistogram(0.01))            # empty right operand
+    assert [full.quantile(q) for q in (50, 95, 99)] == before
+    assert full.n == len(vals)
+    empty = LogHistogram(0.01)
+    empty.merge(full)                         # empty left operand
+    assert [empty.quantile(q) for q in (50, 95, 99)] == before
+    assert empty.vmin == full.vmin and empty.vmax == full.vmax
+    assert empty.total == full.total
+
+
+def test_sketch_zero_negative_merge_and_counts():
+    """Satellite: zero/negative observations live in the shared zero
+    bucket and merge exactly; count_above answers at bucket
+    resolution."""
+    a, b = LogHistogram(0.01), LogHistogram(0.01)
+    a.add(0.0, count=2)
+    a.add(-5.0)
+    a.add(100.0)
+    b.add(-1.0)
+    b.add(200.0, count=3)
+    a.merge(b)
+    assert a.n == 8 and a.n_zero == 4
+    assert a.quantile(25) <= 0.0              # ranks 0..3 are <= 0
+    assert a.quantile(99) <= 200.0 * 1.01
+    assert a.vmin == -5.0 and a.vmax == 200.0
+    assert a.count_above(150.0) == 3
+    assert a.count_above(50.0) == 4
+    assert a.count_above(-10.0) == 8          # zero bucket included
+
+
+def test_sketch_from_dict_with_unseen_buckets_keeps_parity():
+    """Satellite: from_dict round-trip carrying buckets the receiver
+    never observed (a replica whose value range is disjoint) merges
+    with full quantile parity against the pooled stream."""
+    lo, hi, whole = (LogHistogram(0.01), LogHistogram(0.01),
+                     LogHistogram(0.01))
+    lo_vals = [0.001 * (i + 1) for i in range(50)]      # tiny values
+    hi_vals = [1e6 + 1e4 * i for i in range(50)]        # huge values
+    for v in lo_vals:
+        lo.add(v)
+        whole.add(v)
+    for v in hi_vals:
+        hi.add(v)
+        whole.add(v)
+    # serialize hi and fold into lo: every hi bucket index is unseen
+    back = LogHistogram.from_dict(json.loads(json.dumps(hi.to_dict())))
+    assert not set(back.buckets) & set(lo.buckets)
+    lo.merge(back)
+    assert lo.n == whole.n
+    for q in (10, 50, 90, 99):
+        assert lo.quantile(q) == whole.quantile(q)
+
+
 def test_metric_sketches_merge_dict():
     a, b = MetricSketches(0.01), MetricSketches(0.01)
     for i in range(50):
@@ -401,6 +462,134 @@ def test_status_server_serves_both_endpoints():
             urllib.request.urlopen(srv.url("/nope"), timeout=10)
     finally:
         srv.close()
+
+
+def test_status_server_sketches_endpoint_is_mergeable():
+    """/sketches.json serves the SERIALIZED sketches (what a fleet
+    poller merges), not just quantile summaries."""
+    mon = Monitor(label="west-3", flight=0)
+    for i in range(30):
+        mon.note_line({"event": "request", "id": f"r{i}",
+                       "ttft_ms": 10.0 + i, "tokens_in": 1,
+                       "tokens_out": 2, "wall": 100.0 + i})
+    srv = StatusServer(mon, port=0)
+    try:
+        payload = json.loads(urllib.request.urlopen(
+            srv.url("/sketches.json"), timeout=10).read())
+    finally:
+        srv.close()
+    assert payload["label"] == "west-3"
+    other = MetricSketches(rel_err=payload["rel_err"])
+    other.merge_dict(payload["sketches"])
+    assert other.sketches["ttft_ms"].n == 30
+    # worst-K exemplars ride along: the ids behind the tail quantile
+    worst = payload["exemplars"]["ttft_ms"]
+    assert worst[0] == {"value": 39.0, "id": "r29"}
+    assert len(worst) <= 5
+
+
+def test_status_server_busy_port_raises_typed_error():
+    """Satellite: a busy --monitor-port fails with a typed error
+    naming the port, not a bare OSError traceback."""
+    from shallowspeed_tpu.telemetry.monitor import PortInUseError
+
+    mon = _mk_monitor()
+    srv = StatusServer(mon, port=0)
+    try:
+        with pytest.raises(PortInUseError, match=str(srv.port)):
+            StatusServer(mon, port=srv.port)
+        assert issubclass(PortInUseError, OSError)  # callers' except
+    finally:
+        srv.close()
+
+
+def test_prometheus_label_values_are_escaped():
+    """Satellite: replica names are operator input — quotes,
+    backslashes and newlines must not break the exposition parse."""
+    from shallowspeed_tpu.telemetry.fleet import FleetCollector
+    from shallowspeed_tpu.telemetry.monitor import prom_escape
+
+    assert prom_escape('a"b') == 'a\\"b'
+    assert prom_escape("a\\b") == "a\\\\b"
+    assert prom_escape("a\nb") == "a\\nb"
+    fc = FleetCollector()
+    rep = fc.add_file("/nonexistent.jsonl", label='evil"name\nx')
+    rep.alive = True
+    prom = fc.prometheus()
+    assert '{replica="evil\\"name\\nx"} 1' in prom
+    # a raw newline in the label would have split the sample line
+    assert not any(line.startswith('x"}') for line in prom.splitlines())
+
+
+def test_tailer_restarts_after_truncation(tmp_path):
+    """Satellite: when the tailed file SHRINKS (truncation/rotation),
+    the tailer restarts from byte 0 instead of silently reading
+    nothing forever."""
+    from shallowspeed_tpu.telemetry.monitor import iter_jsonl
+
+    path = tmp_path / "m.jsonl"
+    lines = [{"event": "request", "id": f"a{i}", "ttft_ms": 10.0,
+              "tokens_in": 1, "tokens_out": 1, "wall": float(i)}
+             for i in range(20)]
+    path.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    mon = Monitor(flight=0, snapshot_every=0)
+    tailer = FileTailer(path, mon)
+    assert tailer.drain() == 20
+    # rotate: the writer replaces the file with a SHORTER one
+    rotated = [{"event": "request", "id": f"b{i}", "ttft_ms": 99.0,
+                "tokens_in": 1, "tokens_out": 1, "wall": 100.0 + i}
+               for i in range(3)]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rotated))
+    assert tailer.drain() == 3           # NOT zero: restarted at 0
+    assert mon.sketches.sketches["ttft_ms"].n == 23
+    # and keeps following the rotated file
+    with open(path, "a") as f:
+        f.write(json.dumps({"event": "request", "id": "b3",
+                            "ttft_ms": 99.0, "tokens_in": 1,
+                            "tokens_out": 1, "wall": 104.0}) + "\n")
+    assert tailer.drain() == 1
+    # rotation to an EQUAL-OR-LARGER file (size check can't see it):
+    # the inode changed, so the tailer restarts from byte 0
+    bigger = tmp_path / "m.jsonl.new"
+    bigger.write_text("".join(
+        json.dumps({"event": "request", "id": f"c{i}", "ttft_ms": 7.0,
+                    "tokens_in": 1, "tokens_out": 1,
+                    "wall": 200.0 + i}) + "\n" for i in range(30)))
+    import os
+
+    os.replace(bigger, path)
+    assert tailer.drain() == 30
+    # iter_jsonl unit: pos beyond EOF resets to 0
+    recs, pos = iter_jsonl(path, pos=10_000_000)
+    assert len(recs) == 30 and pos > 0
+
+
+def test_schema_v8_straggler_and_lifecycle_lines():
+    from shallowspeed_tpu.telemetry import schema
+
+    assert schema.validate_line(
+        {"event": "straggler", "replica": "r1", "metric": "step_ms",
+         "state": "firing", "ratio": 2.4, "z": 7.1, "replica_q": 120.0,
+         "fleet_q": 50.0, "q": 50, "rounds": 3}) == []
+    assert schema.validate_line(
+        {"event": "straggler", "metric": "step_ms",
+         "state": "firing"}) != []              # replica required
+    assert schema.validate_line(
+        {"event": "straggler", "replica": "r1", "metric": "step_ms",
+         "state": "firing", "ratio": "fast"}) != []
+    assert schema.validate_line(
+        {"event": "lifecycle", "id": "r0", "phase": "prefill",
+         "seq": 3, "chunk": 1, "tokens": 16, "prev": "admitted",
+         "ms_in_prev": 0.52, "tick": 9, "slot": 2}) == []
+    assert schema.validate_line(
+        {"event": "lifecycle", "phase": "prefill"}) != []
+    assert schema.validate_line(
+        {"event": "lifecycle", "id": "r0", "phase": "prefill",
+         "chunk": 1.5}) != []
+    # ph "M" (named trace tracks) is span-dialect-legal
+    assert schema.validate_line(
+        {"name": "thread_name", "ph": "M", "ts": 0.0,
+         "args": {"name": "request r0"}}) == []
 
 
 def test_live_main_once_renders_committed_artifact(capsys):

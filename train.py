@@ -115,6 +115,10 @@ def parse_args(argv=None):
                    help="live telemetry plane (telemetry/monitor): "
                         "/status.json + /metrics on 127.0.0.1:PORT "
                         "while the run is live (0 = free port)")
+    p.add_argument("--replica", type=str, default=None,
+                   help="replica label for fleet views (telemetry/"
+                        "fleet): stamped on run_start and served "
+                        "from /status.json")
     p.add_argument("--slo", type=str, default="",
                    help="declarative SLOs over dual burn-rate windows "
                         "(telemetry/monitor DSL); 'alert' events land "
@@ -328,7 +332,8 @@ def train(args) -> float:
 
     metrics = MetricsLogger(
         args.log_file, dp=args.dp, pp=args.pp, schedule=args.schedule,
-        engine=type(engine).__name__, batch_size=args.batch_size)
+        engine=type(engine).__name__, batch_size=args.batch_size,
+        **({"replica": args.replica} if args.replica else {}))
 
     # goodput ledger (telemetry/goodput): init / val-eval / save time
     # stamped into the same JSONL so `--goodput` decomposes the run

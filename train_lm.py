@@ -311,6 +311,11 @@ def parse_args(argv=None):
                         "quantiles over step time / tok/s, goodput so "
                         "far, health verdict, last fault — while the "
                         "run is live (0 = pick a free port)")
+    p.add_argument("--replica", type=str, default=None,
+                   help="replica label for fleet views (telemetry/"
+                        "fleet): stamped on run_start and served from "
+                        "/status.json so a FleetCollector names this "
+                        "process in breakdowns and straggler events")
     p.add_argument("--slo", type=str, default="",
                    help="declarative SLOs over dual burn-rate "
                         "windows, e.g. 'step_p95_ms<250,"
@@ -823,7 +828,9 @@ def train(args) -> float:
     metrics = MetricsLogger(args.log_file, dp=args.dp, sp=args.sp,
                             seq_len=args.seq_len, d_model=args.d_model,
                             n_layers=args.n_layers,
-                            start_step=start_step)
+                            start_step=start_step,
+                            **({"replica": args.replica}
+                               if args.replica else {}))
 
     # ---- goodput ledger (telemetry/goodput): every non-step second is
     # stamped into the same JSONL the step lines live in — init,
