@@ -427,6 +427,79 @@ def bench_kernel_numerics():
                 "kernel_numerics_error": repr(e)[:200]}
 
 
+def bench_paged_decode_numerics():
+    """Paged flash-decode kernel vs its XLA reference
+    (`serving/cache.gather_table` + `kv_cache.masked_attention`) —
+    the fast-decode analog of `bench_kernel_numerics`, but runnable on
+    EVERY backend: interpret mode off-TPU (the exact code path the CPU
+    test suite pins) and Mosaic-compiled on TPU, so every bench round
+    records the kernel's numerics envelope next to the training
+    kernels'. Covers causal, GQA, and int8-KV pools; errors are
+    relmax vs the f32 reference, pass bar 1e-4 (the pinned parity —
+    both sides compute f32 scores, so the envelope is gather/reorder
+    noise, not a dtype floor). Never raises — a failure lands as
+    paged_decode_numerics_ok: false."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from shallowspeed_tpu.models import transformer as T
+        from shallowspeed_tpu.models.kv_cache import masked_attention
+        from shallowspeed_tpu.ops.flash_attention import paged_flash_decode
+        from shallowspeed_tpu.serving.cache import (gather_table,
+                                                    init_block_pool,
+                                                    write_rows)
+
+        rng = np.random.default_rng(11)
+
+        def err(a, b):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            return float(np.abs(a - b).max()
+                         / max(1e-6, float(np.abs(b).max())))
+
+        entries = {}
+        for name, kvh, quant in (("paged_decode", 0, False),
+                                 ("paged_decode_gqa", 2, False),
+                                 ("paged_decode_int8", 0, True)):
+            cfg = T.TransformerConfig(vocab=64, d_model=256, n_heads=4,
+                                      n_kv_heads=kvh, n_layers=1,
+                                      max_seq=512)
+            bs, n, s, w = 16, 32, 4, 4
+            pool = init_block_pool(cfg, n, bs,
+                                   "int8" if quant else "")[0]
+            bt = rng.integers(1, n, (s, w)).astype(np.int32)
+            pos = np.asarray([bs * w - 1, 17, 40, 3], np.int32)
+            for row in range(s):
+                for p in range(pos[row] + 1):
+                    k = jnp.asarray(rng.normal(
+                        size=(1, cfg.kv_heads, cfg.head_dim)),
+                        jnp.float32)
+                    v = jnp.asarray(rng.normal(
+                        size=(1, cfg.kv_heads, cfg.head_dim)),
+                        jnp.float32)
+                    pool = write_rows(pool, k, v,
+                                      jnp.asarray([bt[row, p // bs]]),
+                                      jnp.asarray([p % bs]), quant)
+            q = jnp.asarray(rng.normal(
+                size=(s, cfg.n_heads, cfg.head_dim)), jnp.float32)
+            got = paged_flash_decode(q, pool, jnp.asarray(bt),
+                                     jnp.asarray(pos))
+            span = jnp.arange(w * bs)
+            valid = (span[None, :] <= pos[:, None])[
+                :, None, None, None, :]
+            ref = masked_attention(q[:, None],
+                                   gather_table(pool, jnp.asarray(bt)),
+                                   valid, cfg)[:, 0]
+            entries[name] = {"flash": round(err(got, ref), 7),
+                             "ref": "gather_table+masked_attention"}
+        ok = all(e["flash"] <= 1e-4 for e in entries.values())
+        return {"paged_decode_numerics_ok": ok, "entries": entries}
+    except Exception as e:  # pragma: no cover — keep the headline robust
+        return {"paged_decode_numerics_ok": False,
+                "paged_decode_error": repr(e)[:200], "entries": {}}
+
+
 def overlap_case_child():
     """`bench.py --overlap-child`: the dp>1/accum>1 comm-overlap case,
     run in a fresh process whose parent configured a 2-virtual-device
@@ -613,21 +686,29 @@ def bench_serving() -> dict:
         cfg = T.TransformerConfig(vocab=128, d_model=64, n_heads=4,
                                   n_layers=2, max_seq=256)
         params = jax.device_put(T.init(cfg, seed=0))
-        rng = np.random.default_rng(0)
         lens = [8, 20, 33, 48]
         max_new = 24
 
-        def build():
+        def build(spec_k=0):
             return ServingEngine(params, cfg, n_blocks=96,
                                  block_size=16, max_slots=8,
-                                 prefill_chunk=32)
+                                 prefill_chunk=32, spec_k=spec_k)
+
+        def prompt(i):
+            # self-similar prompts (a repeated motif): the spec-on
+            # sweep's n-gram proposer needs repetition to draft from,
+            # like real templated/code traffic. Seeded per request id
+            # — NOT the shared rng — so spec-on and spec-off levels
+            # serve byte-identical prompts and compare fairly
+            t = lens[i % len(lens)]
+            motif = np.random.default_rng([7, i]).integers(
+                0, cfg.vocab, max(2, t // 3)).astype(np.int32)
+            reps = -(-t // motif.shape[0])
+            return np.concatenate([motif] * reps)[:t]
 
         def offer(eng, n):
             for i in range(n):
-                eng.submit(rng.integers(0, cfg.vocab,
-                                        lens[i % len(lens)]).astype(
-                                            np.int32),
-                           max_new, rid=f"l{n}_{i}")
+                eng.submit(prompt(i), max_new, rid=f"l{n}_{i}")
             t0 = time.perf_counter()
             eng.run()
             wall = time.perf_counter() - t0
@@ -642,24 +723,48 @@ def bench_serving() -> dict:
                 - next(p["wall"] for p in tl if p["phase"] == "admitted")
                 for tl in eng.timelines.values()
                 if any(p["phase"] == "decoding" for p in tl)]
-            return {"offered": n, "wall_s": round(wall, 3),
-                    "tok_per_sec": round(toks / wall, 2),
-                    "ttft_p50_ms": round(p50("ttft_ms"), 2),
-                    "tpot_p50_ms": round(p50("tpot_ms"), 2),
-                    "prefill_p50_ms": round(
-                        float(np.median(prefill)) * 1e3, 2)
-                    if prefill else None}
+            out = {"offered": n, "wall_s": round(wall, 3),
+                   "tok_per_sec": round(toks / wall, 2),
+                   "ttft_p50_ms": round(p50("ttft_ms"), 2),
+                   "tpot_p50_ms": round(p50("tpot_ms"), 2),
+                   "prefill_p50_ms": round(
+                       float(np.median(prefill)) * 1e3, 2)
+                   if prefill else None}
+            if eng.spec_k:
+                d = eng.counters["spec_drafted"]
+                out["ticks"] = eng.counters["ticks"]
+                out["spec_drafted"] = d
+                out["spec_accepted"] = eng.counters["spec_accepted"]
+                out["spec_accept_rate"] = round(
+                    eng.counters["spec_accepted"] / d, 4) if d else 0.0
+            return out
 
         # compile warmup (excluded): n=4 walks the tick through BOTH
         # table-width buckets the levels use (W=4 early, W=8 once the
         # longest prompt's table grows past 4 blocks)
         offer(build(), 4)
+        # spec-on/off sweep at identical offered load: speculation
+        # amortizes the per-tick weight sweep over accepted drafts in
+        # otherwise-empty rows, and the streams are token-identical
+        # by construction — so tok/s is directly comparable
         levels = [offer(build(), n) for n in (1, 4, 8)]
+        spec_levels = [offer(build(spec_k=4), n) for n in (1, 4, 8)]
+        # the headline keeps its spec-OFF contract (best gather-path
+        # level, the round-11 metric --regress has banded since r07);
+        # the spec-on sweep gets its OWN gated headline so neither
+        # path's regression can hide behind the other's speedup
         return {"serving_case": {"levels": levels,
+                                 "spec_levels": spec_levels,
                                  "block_size": 16, "slots": 8,
-                                 "prefill_chunk": 32},
+                                 "prefill_chunk": 32, "spec_k": 4},
                 "serving_tok_per_sec": max(lv["tok_per_sec"]
-                                           for lv in levels)}
+                                           for lv in levels),
+                "serving_spec_tok_per_sec": max(
+                    lv["tok_per_sec"] for lv in spec_levels),
+                "serving_spec_accept_rate": round(
+                    sum(lv["spec_accepted"] for lv in spec_levels)
+                    / max(1, sum(lv["spec_drafted"]
+                                 for lv in spec_levels)), 4)}
     except Exception as e:  # pragma: no cover — keep the headline robust
         return {"serving_error": repr(e)[:200]}
 
@@ -713,6 +818,13 @@ def main():
     }
     out.update(bench_transformer_mfu())
     out.update(bench_kernel_numerics())
+    # paged flash-decode numerics run on EVERY backend (interpret mode
+    # off-TPU); its entries join the same kernel_numerics_rel_err block
+    pg = bench_paged_decode_numerics()
+    entries = pg.pop("entries", {})
+    if entries:
+        out.setdefault("kernel_numerics_rel_err", {}).update(entries)
+    out.update(pg)
     out.update(bench_overlap())
     out.update(bench_attribution())
     out.update(bench_serving())

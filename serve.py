@@ -59,6 +59,26 @@ def parse_args(argv=None):
     s.add_argument("--prefill-chunk", type=int, default=64)
     s.add_argument("--table-bucket", type=int, default=4)
     s.add_argument("--kv-quant", default="", choices=["", "int8"])
+    s.add_argument("--weight-quant", default="",
+                   choices=["", "int8", "fp8"],
+                   help="quantized weight storage with fused dequant "
+                        "(per-out-channel f32 scales; halves the "
+                        "param sweep behind every decode tick)")
+    s.add_argument("--attn-impl", default="gather",
+                   choices=["gather", "flash"],
+                   help="decode-tick attention: 'gather' = the XLA "
+                        "reference (gather_table + masked_attention), "
+                        "'flash' = the paged Pallas flash-decode "
+                        "kernel (grid over the block table, no "
+                        "gathered copy)")
+    s.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: up to K self-drafted "
+                        "(n-gram prompt-lookup) tokens per decoding "
+                        "request per tick, verified in the same "
+                        "compiled tick's free rows; 0 = off. Output "
+                        "streams are token-identical to spec-off")
+    s.add_argument("--spec-ngram", type=int, default=3,
+                   help="longest n-gram the draft proposer matches")
     s.add_argument("--top-k", type=int, default=0)
     s.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--requests", default="-",
@@ -181,7 +201,9 @@ def main(argv=None) -> int:
                     d_model=cfg.d_model, n_layers=cfg.n_layers,
                     n_blocks=args.n_blocks, block_size=args.block_size,
                     slots=args.slots, prefill_chunk=args.prefill_chunk,
-                    kv_quant=args.kv_quant)
+                    kv_quant=args.kv_quant,
+                    weight_quant=args.weight_quant,
+                    attn_impl=args.attn_impl, spec_k=args.spec_k)
     if args.replica:
         run_info["replica"] = args.replica
     metrics = MetricsLogger(args.log_file, **run_info)
@@ -198,6 +220,8 @@ def main(argv=None) -> int:
         block_size=args.block_size, max_slots=args.slots,
         prefill_chunk=args.prefill_chunk,
         table_bucket=args.table_bucket, kv_quant=args.kv_quant,
+        weight_quant=args.weight_quant, attn_impl=args.attn_impl,
+        spec_k=args.spec_k, spec_ngram=args.spec_ngram,
         top_k=args.top_k, top_p=args.top_p, metrics=metrics,
         log_every=args.log_every)
 
@@ -287,6 +311,8 @@ def main(argv=None) -> int:
             "prefill_chunks": eng.counters["prefill_chunks"],
             "preemptions": eng.counters["preempted"],
             "shed_toggles": eng.counters["shed_toggles"],
+            "spec_drafted": eng.counters["spec_drafted"],
+            "spec_accepted": eng.counters["spec_accepted"],
             "pending_at_exit": eng.pending(),
             "executables": eng.executable_counts(),
             "blocks_free_at_drain":

@@ -491,6 +491,124 @@ def overlap_bucket(probe) -> list:
     return out
 
 
+# --------------------------------------------------------- dequant fusion
+
+# quantized-storage dtypes the serving decode path reads (int8 weights
+# and KV blocks; fp8-e4m3 weights where the build ships it)
+_QUANT_DTYPES = {"int8", "uint8", "float8_e4m3fn", "float8_e5m2"}
+
+# shape-preserving primitives a weight buffer may pass through between
+# its upcast and its consumer without changing what's materialized
+_PASSTHROUGH = {"reshape", "transpose", "broadcast_in_dim", "squeeze",
+                "copy"}
+
+
+def _is_quant(var) -> bool:
+    dt = getattr(var.aval, "dtype", None)
+    return (dt is not None and str(np.dtype(dt)) in _QUANT_DTYPES
+            and len(getattr(var.aval, "shape", ())) >= 2)
+
+
+@rule("dequant-fusion")
+def dequant_fusion(probe) -> list:
+    """Quantized weights must dequantize INTO the matmul, never into a
+    buffer. The whole point of int8/fp8 weight storage is reading one
+    byte per element from HBM; the classic way to lose it is
+
+        (wq.astype(f32) * scale) @ x     # a full (K, N) dequant copy
+
+    where the scale multiply (or any other elementwise op) materializes
+    a full-weight-size floating buffer between the upcast and the dot.
+    The FUSED form (`ops.matmul.dequant_matmul`) upcasts the values
+    directly into the dot operand — XLA folds that convert into the
+    operand load — and applies the scale to the f32 ACCUMULATOR.
+
+    Mechanically: for every `convert_element_type` whose input chains
+    back (through shape-preserving ops only — a gather breaks the
+    chain, so gathered int8 KV *views* are exempt) to an int8/fp8
+    buffer of rank >= 2, every consumer of the upcast value must be a
+    `dot_general` (possibly through more shape-preserving ops). Any
+    elementwise consumer producing a full-weight-size floating output
+    is a materialized dequantized copy: HIGH."""
+    out = []
+    for ep in probe.entrypoints:
+        for jaxpr, path in probe.jaxpr_scopes(ep):
+            made_by = {}
+            consumers: dict = {}
+            for eqn in jaxpr.eqns:
+                for v in eqn.outvars:
+                    made_by[v] = eqn
+                for v in eqn.invars:
+                    if not isinstance(v, jax.core.Literal):
+                        consumers.setdefault(v, []).append(eqn)
+
+            def root_of(var):
+                seen = 0
+                while seen < 32:
+                    eqn = made_by.get(var)
+                    if eqn is None \
+                            or eqn.primitive.name not in _PASSTHROUGH:
+                        return var
+                    var = eqn.invars[0]
+                    seen += 1
+                return var
+
+            def check_uses(var, size, depth=0):
+                """Every (transitive, through passthrough) use of the
+                upcast buffer must be a dot; return the offending eqn
+                otherwise."""
+                for use in consumers.get(var, ()):
+                    name = use.primitive.name
+                    if name == "dot_general":
+                        continue
+                    if name in _PASSTHROUGH and depth < 8:
+                        bad = check_uses(use.outvars[0], size, depth + 1)
+                        if bad is not None:
+                            return bad
+                        continue
+                    out_avals = [o.aval for o in use.outvars]
+                    # jnp.issubdtype, not np: bf16/fp8 are ml_dtypes
+                    # extensions numpy does not class as floating
+                    if any(int(np.prod(getattr(a, "shape", ()),
+                                       dtype=np.int64)) == size
+                           and jax.numpy.issubdtype(
+                               getattr(a, "dtype", np.int32),
+                               jax.numpy.floating)
+                           for a in out_avals):
+                        return use
+                return None
+
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name != "convert_element_type":
+                    continue
+                src = root_of(eqn.invars[0])
+                if isinstance(src, jax.core.Literal) \
+                        or not _is_quant(src):
+                    continue
+                o = eqn.outvars[0]
+                odt = getattr(o.aval, "dtype", None)
+                if odt is None or not jax.numpy.issubdtype(
+                        odt, jax.numpy.floating):
+                    continue
+                size = int(np.prod(o.aval.shape, dtype=np.int64))
+                if size != int(np.prod(src.aval.shape,
+                                       dtype=np.int64)):
+                    continue   # the upcast is of a slice, not the weight
+                bad = check_uses(o, size)
+                if bad is not None:
+                    out.append(Finding(
+                        "dequant-fusion", Severity.HIGH, probe.name,
+                        ep.name, path,
+                        f"{str(np.dtype(src.aval.dtype))} weight "
+                        f"{tuple(src.aval.shape)} upcast to "
+                        f"{np.dtype(odt)} is consumed by "
+                        f"'{bad.primitive.name}' at full weight size — "
+                        f"a materialized dequantized copy; apply the "
+                        f"scale to the f32 accumulator instead "
+                        f"(ops.matmul.dequant_matmul)"))
+    return out
+
+
 # ------------------------------------------------------- memory highwater
 
 

@@ -325,6 +325,53 @@ def build_pipeline_lm(schedule: str = "gpipe", virtual_pp: int = 1,
     return probe.seal()
 
 
+# ------------------------------------------------------- serving probe
+
+
+def build_serving_decode(budget: int = DEFAULT_BUDGET) -> TargetProbe:
+    """The serving fast-decode tick (`serving/engine._decode_tick`) at
+    the full quantized configuration: int8 weights (fused-dequant
+    matmul), int8 KV pools, and the paged Pallas flash-decode kernel.
+    The `dequant-fusion` rule's live target — the traced tick must
+    never materialize a full-size dequantized weight copy — plus the
+    standard dtype/memory sweeps over the kernel's sub-jaxpr."""
+    import jax.numpy as jnp  # noqa: F401  (symmetry with other builders)
+
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.serving.engine import ServingEngine, _decode_tick
+
+    cfg = T.TransformerConfig(vocab=32, d_model=32, n_heads=4,
+                              n_layers=2, max_seq=64)
+    eng = ServingEngine(T.init(cfg, seed=0), cfg, n_blocks=8,
+                        block_size=8, max_slots=2, prefill_chunk=8,
+                        weight_quant="int8", kv_quant="int8",
+                        attn_impl="flash")
+    eng.submit(np.arange(6, dtype=np.int32) % cfg.vocab, 4)
+    eng.run()
+
+    def tick(params, pools, tok, pos, bt, temp, seeds, idx):
+        return _decode_tick(params, pools, tok, pos, bt, temp, seeds,
+                            idx, cfg=cfg, top_k=0, top_p=0.0,
+                            attn="flash")
+
+    s = eng.max_slots
+    w = 4
+    probe = TargetProbe("serving:decode", None, None, hbm_budget=budget)
+    probe.entrypoints = [
+        EntryPoint("_decode_tick", tick,
+                   (_sds(eng.params), _sds(eng.pools),
+                    jax.ShapeDtypeStruct((s,), np.int32),
+                    jax.ShapeDtypeStruct((s,), np.int32),
+                    jax.ShapeDtypeStruct((s, w), np.int32),
+                    jax.ShapeDtypeStruct((s,), np.float32),
+                    jax.ShapeDtypeStruct((s,), np.uint32),
+                    jax.ShapeDtypeStruct((s,), np.int32)),
+                   ("params", "pools", "tok", "pos", "bt", "temp",
+                    "seeds", "idx")),
+    ]
+    return probe.seal()
+
+
 # ----------------------------------------------------------- the registry
 
 TARGET_BUILDERS: dict[str, Callable] = {
@@ -340,6 +387,7 @@ TARGET_BUILDERS: dict[str, Callable] = {
         build_pipeline_lm("1f1b", virtual_pp=2, budget=budget),
     "pipeline_lm:zb": lambda budget=DEFAULT_BUDGET:
         build_pipeline_lm("zb", compute_dtype=None, budget=budget),
+    "serving": build_serving_decode,
 }
 
 # CLI aliases: family names expand to their member probes

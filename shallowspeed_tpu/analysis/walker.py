@@ -117,7 +117,14 @@ def aval_bytes(aval) -> int:
     dtype = getattr(aval, "dtype", None)
     if shape is None or dtype is None:
         return 0
-    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    try:
+        item = np.dtype(dtype).itemsize
+    except TypeError:
+        # jax extended dtypes (typed PRNG keys, `key<fry>`) are not
+        # numpy dtypes but expose their physical payload size — the
+        # serving decode tick's per-row samplers put them in scope
+        item = int(getattr(dtype, "itemsize", 0) or 0)
+    return int(np.prod(shape, dtype=np.int64)) * item
 
 
 def _inner_extra(eqn) -> int | None:

@@ -250,6 +250,85 @@ def init(cfg: TransformerConfig, seed: int = 0):
 
 _NORM_KEYS = {"ln1", "ln2", "ln_f"}
 
+# Quantized weight-storage leaves (see `quantize_weights`): "Wq" is the
+# int8/fp8 value tensor, "Ws" the per-out-channel f32 scales. Both stay
+# in their STORAGE dtype through `cast_params` — casting Wq would
+# materialize the full-size dequantized copy the storage exists to
+# avoid (the analysis `dequant-fusion` rule), and casting Ws to bf16
+# would quantize the scales for no byte win (they are O(N), not O(K*N)).
+_QUANT_KEYS = {"Wq", "Ws"}
+
+WEIGHT_QUANT_MODES = ("", "int8", "fp8")
+
+# fp8 weight storage uses e4m3 where this jax/XLA build ships it;
+# otherwise `quantize_weights("fp8")` raises rather than silently
+# storing something else.
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+
+def quantize_weights(params, mode: str):
+    """Quantize every dense projection's weight matrix for the decode
+    path: each {"W": (K, N), "b"} dict in the pytree (block q/kv/qkv,
+    proj, up/down/gate, the untied head) becomes {"Wq": (K, N) int8 or
+    fp8-e4m3, "Ws": (N,) f32 per-out-channel scales, "b"}. Consumers
+    dispatch on the "Wq" leaf (`_dense`) and run the fused-dequant
+    matmul (`ops.matmul.dequant_matmul`) — the scale lands on the f32
+    accumulator, the weight is read at 1 byte/element.
+
+    Deliberately NOT quantized: embeddings (their decode read is one
+    gathered row per token, not a sweep), norm scales and biases
+    (O(d) — noise next to the matrices), and MoE expert banks (no
+    serving path yet; ROADMAP item 5 extends this to training).
+    Symmetric per-out-channel absmax scaling; mode "" returns the tree
+    unchanged. A typed error, not an assert — this gates a production
+    storage layout."""
+    if mode not in WEIGHT_QUANT_MODES:
+        raise ValueError(
+            f"unsupported weight_quant={mode!r}; expected one of "
+            f"{WEIGHT_QUANT_MODES} ('' = weights in the master dtype)")
+    if not mode:
+        return params
+    if mode == "fp8" and _FP8_DTYPE is None:
+        raise ValueError(
+            "weight_quant='fp8' needs float8_e4m3fn support in this "
+            "jax/XLA build; use 'int8'")
+
+    def quant_dense(p):
+        w = jnp.asarray(p["W"], jnp.float32)
+        amax = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8)   # (N,)
+        if mode == "int8":
+            ws = amax / 127.0
+            wq = jnp.clip(jnp.round(w / ws), -127, 127).astype(jnp.int8)
+        else:  # e4m3: max normal is 448
+            ws = amax / 448.0
+            wq = (w / ws).astype(_FP8_DTYPE)
+        rest = {k: v for k, v in p.items() if k != "W"}
+        return {"Wq": wq, "Ws": ws.astype(jnp.float32), **rest}
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "W" in node and np.ndim(node["W"]) == 2:
+                return quant_dense(node)
+            if "Wq" in node:          # already quantized: idempotent
+                return node
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(params)
+
+
+def weight_quant_mode(params) -> str:
+    """The storage mode of a (possibly) quantized tree: "int8"/"fp8"
+    when `quantize_weights` leaves are present, else ""."""
+    for leaf in jax.tree_util.tree_leaves(params):
+        if leaf.dtype == jnp.int8 and leaf.ndim == 2:
+            return "int8"
+        if _FP8_DTYPE is not None and leaf.dtype == _FP8_DTYPE:
+            return "fp8"
+    return ""
+
 
 def cast_params(params, compute_dtype):
     """Mixed-precision boundary: float leaves to `compute_dtype` (None =
@@ -262,12 +341,19 @@ def cast_params(params, compute_dtype):
     only quantize the scales and pay a dead f32->bf16->f32 round trip
     per use — the `analysis` dtype rule's round-trip finding (round 6).
     Norm OUTPUTS are cast to the activation dtype as before, so every
-    matmul's operand dtypes are unchanged."""
+    matmul's operand dtypes are unchanged.
+
+    Quantized-storage leaves (Wq/Ws, `quantize_weights`) likewise stay
+    put: int8 is non-floating anyway, but fp8-e4m3 IS floating and a
+    blanket cast would silently rewiden it to bf16 — the full-size
+    dequantized copy the `dequant-fusion` analysis rule exists to
+    catch; the f32 scales are numerics, not bulk bytes."""
     if compute_dtype is None:
         return params
 
     def cast(path, p):
-        if any(getattr(k, "key", None) in _NORM_KEYS for k in path):
+        keys = {getattr(k, "key", None) for k in path}
+        if keys & _NORM_KEYS or keys & _QUANT_KEYS:
             return p
         return (p.astype(compute_dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p)
@@ -301,6 +387,11 @@ def _norm(p, x, cfg: TransformerConfig):
 
 
 def _dense(p, x):
+    if "Wq" in p:  # quantized storage (`quantize_weights`): the scale
+        #            lands on the f32 accumulator, never on the weight
+        from shallowspeed_tpu.ops.matmul import dequant_matmul
+
+        return dequant_matmul(x, p["Wq"], p["Ws"]) + p["b"]
     return x @ p["W"] + p["b"]
 
 

@@ -916,3 +916,177 @@ def _ring_bwd_rule(axis_name, causal, window, res, do):
 
 
 ring_flash_attention.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+# ------------------------------------------------------ paged flash decode
+#
+# Single-query attention for the serving runtime's paged KV cache
+# (round 14, ROADMAP item 1). The XLA reference path
+# (`serving/cache.gather_table` + `kv_cache.masked_attention`) first
+# MATERIALIZES each row's gathered table — a contiguous
+# (rows, Hkv, W*bs, hd) copy of every live block — and then attends
+# over it: the hot decode tick pays the cache sweep twice (gather
+# write + attention read). This kernel grids DIRECTLY over the block
+# table instead — grid (slot, kv head, table column), with the table
+# and each row's position as SCALAR-PREFETCH operands so the k/v
+# BlockSpec index maps dereference `bt[slot, col]` and DMA exactly the
+# pool block each program needs. The gather disappears from the hot
+# path; online-softmax scratch merges the per-block partials across
+# the innermost table-column axis (same (m, l, acc) carry as the
+# training kernels above).
+#
+# int8 pools are read NATIVELY: the int8 k/v blocks and their f32
+# scale planes stream into VMEM as stored, K's per-position scale
+# multiplies the score row and V's folds into the probability row —
+# the same outside-the-dot placement as `masked_attention`, so HBM
+# reads stay 1 byte/element and the reference parity is fp-reorder
+# noise only (pinned <= 1e-4 in tests/test_serving.py; compiled-mode
+# envelope recorded in bench.py's kernel_numerics_rel_err block).
+
+
+def _paged_decode_kernel(bt_ref, pos_ref, *refs, scale, bs, w, window,
+                         groups, quant):
+    """Grid (slot, kv head, table col). One program attends this
+    slot's query group against ONE pool block of its table; scratch
+    carries the online softmax across the sequential col axis. With
+    `quant`, the int8 k/v blocks arrive as stored and their f32 scale
+    planes ride as separate (bs, 1) operands — the DMA reads stay
+    1 byte/element."""
+    if quant:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    s = pl.program_id(0)
+    jw = pl.program_id(2)
+
+    @pl.when(jw == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    p = pos_ref[s]
+    base = jw * bs
+    # tiles whose whole block is masked (beyond this row's position, or
+    # before its window) skip compute AND their stats update; their DMA
+    # still lands — the table is data, so the grid cannot shrink per
+    # row — but scratch carries the merge past them unchanged
+    live = base <= p
+    if window > 0:
+        live = jnp.logical_and(live, base + bs - 1 > p - window)
+
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)                   # (G, hd)
+        kb = k_ref[0].astype(jnp.float32)                  # (bs, hd)
+        vb = v_ref[0].astype(jnp.float32)
+        if quant:
+            ks = ks_ref[0, :, 0].astype(jnp.float32)       # (bs,)
+            vs = vs_ref[0, :, 0].astype(jnp.float32)
+        sc = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        if quant:
+            sc = sc * ks[None, :]
+        sc = sc * scale
+        col = base + jax.lax.broadcasted_iota(
+            jnp.int32, (groups, bs), 1)
+        valid = col <= p
+        if window > 0:
+            valid = valid & (col > p - window)
+        sc = jnp.where(valid, sc, _NEG)
+        m = m_scr[:, 0:1]
+        l = l_scr[:, 0:1]
+        m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+        pr = jnp.where(valid, jnp.exp(sc - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + pr.sum(axis=-1, keepdims=True)
+        if quant:  # V's scale folds into the probability row (tiny),
+            #        keeping the V read int8 — masked_attention's rule;
+            #        the normalizer l is accumulated UNSCALED above
+            pr = pr * vs[None, :]
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            pr, vb, preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(jw == w - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        o_ref[0] = (acc_scr[...]
+                    / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_flash_decode(q, pool_blk, bt, pos, *, window: int = 0,
+                       interpret: bool | None = None):
+    """Single-token attention through a paged block table, fused.
+
+    q: (S, H, hd) — one query token per slot; pool_blk: one layer's
+    pools {"k"/"v": (N, Hkv, bs, hd)[, "k_s"/"v_s": (N, Hkv, bs, 1)
+    f32 scales — int8 pools]}; bt: (S, W) int32 block tables (padding
+    columns point at the scratch block); pos: (S,) int32 — each slot's
+    current position (valid cache span is [0, pos], optionally
+    windowed). Returns (S, H, hd) in q's dtype.
+
+    Matches `masked_attention(q, gather_table(pool, bt), valid)` — the
+    XLA reference that stays in `serving/cache.py` — to fp-reorder
+    noise (<= 1e-4 pinned): same f32 score/softmax path, same
+    outside-the-dot int8 scale placement, no gathered copy. GQA is
+    native (H = G * Hkv query heads fold into the program's row axis).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, h, hd = q.shape
+    kp, vp = pool_blk["k"], pool_blk["v"]
+    n, hkv, bs, _ = kp.shape
+    assert h % hkv == 0, (h, hkv)
+    g = h // hkv
+    w = bt.shape[1]
+    quant = "k_s" in pool_blk
+    scale = 1.0 / float(np.sqrt(hd))
+    q4 = q.reshape(s, hkv, g, hd)
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=scale, bs=bs, w=w,
+        window=int(window), groups=g, quant=quant)
+
+    def _deref(i, j, k_, bt_ref, pos_ref):
+        # the paged gather, moved into the index map: each program's
+        # k/v (and scale-plane) DMA fetches the pool block its table
+        # column names — no contiguous gathered copy is ever built
+        return (bt_ref[i, k_], j, 0, 0)
+
+    qspec = pl.BlockSpec((1, None, g, hd),
+                         lambda i, j, k_, bt_ref, pos_ref: (i, j, 0, 0))
+    blkspec = pl.BlockSpec((1, None, bs, hd), _deref)
+    sclspec = pl.BlockSpec((1, None, bs, 1), _deref)
+    if quant:
+        in_specs = [qspec, blkspec, sclspec, blkspec, sclspec]
+        operands = (q4, kp, pool_blk["k_s"], vp, pool_blk["v_s"])
+    else:
+        in_specs = [qspec, blkspec, blkspec]
+        operands = (q4, kp, vp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, hkv, w),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, None, g, hd),
+                               lambda i, j, k_, bt_ref, pos_ref:
+                               (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, _LANES), jnp.float32),  # running max m
+            pltpu.VMEM((g, _LANES), jnp.float32),  # running norm l
+            pltpu.VMEM((g, hd), jnp.float32),      # unnormalized out
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_sds((s, hkv, g, hd), q.dtype, q),
+        interpret=interpret,
+    )(bt, pos, *operands)
+    return out.reshape(s, h, hd)
+
+
+paged_flash_decode.supports_gqa = True
+paged_flash_decode.supports_window = True

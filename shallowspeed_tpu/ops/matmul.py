@@ -43,6 +43,45 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# ------------------------------------------------ fused-dequant matmul
+#
+# Quantized WEIGHT storage (round 14, ROADMAP item 1): decode is
+# HBM-bound on the parameter sweep, so int8 (or fp8-e4m3) weights with
+# per-out-channel f32 scales halve-or-better the bytes behind
+# `serving/cache.param_read_bytes`. The trap is dequantizing wrong: a
+# `(wq * scale).astype(f32)` materializes a FULL-SIZE dequantized copy
+# of the weight — the exact HBM traffic the storage was meant to
+# remove. The contract here is the fused form, proved statically by
+# the analysis `dequant-fusion` rule over the traced decode tick.
+
+
+def dequant_matmul(x, wq, ws, *, compute_dtype=None):
+    """x (..., K) @ quantized wq (K, N) with per-out-channel f32 scales
+    ws (N,), the dequant FUSED into the matmul:
+
+    - wq's VALUES are cast to the compute dtype inside the dot. That is
+      a value cast, not a dequant — int8 integers and e4m3 floats are
+      both exactly representable in bf16/f32 — and XLA folds it into
+      the operand load, so HBM reads stay 1 byte/element.
+    - accumulation is f32 (`preferred_element_type`), matching every
+      other MXU dot in the repo.
+    - the scale multiplies the f32 ACCUMULATOR (shape (..., N)), never
+      the weight: no (K, N) dequantized buffer ever exists. The
+      per-out-channel scale is constant along the contraction axis,
+      which is what makes this reassociation exact.
+
+    Returns (..., N) in x's dtype. The analysis `dequant-fusion` rule
+    walks consumers of every int8/fp8 weight upcast and flags any
+    full-weight-size elementwise use — this function is its clean
+    fixture."""
+    cdt = compute_dtype or x.dtype
+    acc = jax.lax.dot_general(
+        x.astype(cdt), wq.astype(cdt),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (acc * ws.astype(jnp.float32)).astype(x.dtype)
+
+
 @partial(jax.jit,
          static_argnames=("bm", "bk", "bn", "out_dtype", "interpret"))
 def blocked_matmul(x, y, *, bm: int = 512, bk: int = 512, bn: int = 1024,

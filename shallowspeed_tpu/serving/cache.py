@@ -179,9 +179,18 @@ def write_rows(pool_blk, k_rows, v_rows, blk_ids, offs, quant: bool):
 
 def param_read_bytes(params, cfg: T.TransformerConfig) -> int:
     """Bytes one decode pass reads for the parameters alone, at the
-    dtype decode actually consumes after `cast_params` (eval_shape —
-    no on-device copy). Constant for an engine's lifetime: callers on
-    a hot path compute it once and pass it back in."""
+    PER-LEAF dtypes decode actually consumes after `cast_params`
+    (eval_shape — no on-device copy): float leaves at the compute
+    dtype, quantized-storage leaves (int8/fp8 `Wq` + f32 `Ws` scales,
+    `T.quantize_weights`) at their storage dtypes — cast_params skips
+    them, so an int8-weight model prices at ~0.5x its bf16 self. One
+    model can mix int8 weights, f32 scales, bf16 embeddings, and int8
+    KV (priced separately below) in a single accounting. Pinned in
+    tests/test_serving.py against the traced decode tick's own param
+    invar bytes (the walker pin, same trick as
+    `decode_read_bytes_per_token` in PR 5). Constant for an engine's
+    lifetime: callers on a hot path compute it once and pass it back
+    in."""
     import jax
 
     from shallowspeed_tpu.analysis.walker import aval_bytes
@@ -197,12 +206,18 @@ def paged_read_bytes_per_tick(params, cfg: T.TransformerConfig,
                               n_rows: int, kv_quant: str = "",
                               p_bytes: int | None = None) -> int:
     """HBM READ bytes one decode tick usefully moves: every param leaf
-    (at the decode compute dtype) + the K/V bytes of the live blocks
-    the tick's active requests attend over (+ int8 scale planes) + the
-    token ids. `blocks_touched` = sum over active rows of
-    blocks_for(context_len) — the live-blocks generalization of the
+    (at its ACTUAL post-cast dtype — int8/fp8 weights and f32 scales
+    included, see `param_read_bytes`) + the K/V bytes of the live
+    blocks the tick's active requests attend over (+ int8 scale
+    planes) + the token ids. `blocks_touched` = sum over active rows
+    of blocks_for(context_len) — the live-blocks generalization of the
     contiguous model's full-cache sweep. Pass a precomputed `p_bytes`
-    (`param_read_bytes`) on hot paths — the param term never changes."""
+    (`param_read_bytes`) on hot paths — the param term never changes.
+
+    This is the byte model behind the fast-decode gates: the
+    int8-weight tick must price at <= 0.55x its bf16 baseline (pinned
+    in tests/test_serving.py against walker-traced invar bytes), and
+    the serving progress lines' hbm_gbps derives from it."""
     import numpy as np
 
     if p_bytes is None:

@@ -44,6 +44,41 @@ head-of-line behind the longest. This engine serves a STREAM:
   to the engine tick counter. Fleet views resolve a burning SLO to
   "which request, which phase, which replica" through this.
 
+- **Fast decode path** (round 14, ROADMAP item 1) — three composable
+  levers, each individually gated:
+  - *Quantized weight storage* (`weight_quant="int8"|"fp8"`): the
+    params tree is quantized ONCE at init (`T.quantize_weights`) into
+    int8/fp8-e4m3 matrices + per-out-channel f32 scales; every dense
+    in the tick runs the fused-dequant matmul
+    (`ops.matmul.dequant_matmul` — scale on the f32 accumulator,
+    never a materialized dequantized copy; proved by the analysis
+    `dequant-fusion` rule over this very tick). The params term of
+    `paged_read_bytes_per_tick` shrinks to ~0.5x bf16.
+  - *Paged flash-decode kernel* (`attn_impl="flash"`): the tick's
+    attention runs `ops.flash_attention.paged_flash_decode` — grid
+    over the block table via scalar-prefetch index maps, online
+    softmax across a row's blocks, int8 KV + scales read natively —
+    instead of materializing `gather_table`'s contiguous copy.
+    `gather` stays the default AND the reference the kernel is pinned
+    against (<= 1e-4).
+  - *Speculative decoding* (`spec_k > 0`): a self-drafting n-gram
+    prompt-lookup proposer (`_propose`) fills FREE rows of the
+    fixed-capacity tick with up to K draft tokens per decoding
+    request at consecutive positions; the same compiled tick verifies
+    them all in one pass (each row's mask admits the rows before it —
+    the in-tick writes land before any gather). Acceptance is the
+    deterministic accept/resample rule specialized to a point-mass
+    (deterministic) draft distribution under a counter-based sampler:
+    every emitted token IS the oracle draw `sample(fold_in(
+    PRNGKey(seed), i), logits_i)` at its own index — row j's logits
+    are the true next-token logits whenever all earlier drafts
+    matched their oracle draws — so the output stream is
+    TOKEN-IDENTICAL to solo `generate()` at every temperature, not
+    merely distribution-equal. Rejected rows' cache writes sit beyond
+    the request's advanced position and are overwritten before any
+    mask can admit them (the prefill-padding argument). Zero new
+    executables: drafts are data in rows that already executed empty.
+
 Stream parity: sampling uses the SAME per-request key schedule as
 `generate()` — token i of a request with sampling seed s draws from
 `fold_in(PRNGKey(s), i)` — and the paged attention shares
@@ -65,6 +100,7 @@ import numpy as np
 
 from shallowspeed_tpu import chaos
 from shallowspeed_tpu.models import generate as G
+from shallowspeed_tpu.ops.flash_attention import paged_flash_decode
 from shallowspeed_tpu.telemetry.trace import tracer
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.models.kv_cache import masked_attention
@@ -126,10 +162,11 @@ def _sample_rows(logits, temp, seeds, idx, top_k: int, top_p: float):
 _sample_jit = jax.jit(_sample_rows, static_argnames=("top_k", "top_p"))
 
 
-@partial(jax.jit, static_argnames=("cfg", "top_k", "top_p"),
+@partial(jax.jit, static_argnames=("cfg", "top_k", "top_p", "attn"),
          donate_argnums=(1,))
 def _decode_tick(params, pools, tok, pos, bt, temp, seeds, idx, *,
-                 cfg: T.TransformerConfig, top_k: int, top_p: float):
+                 cfg: T.TransformerConfig, top_k: int, top_p: float,
+                 attn: str = "gather"):
     """One compiled decode tick over the whole slot batch.
 
     tok/pos/temp/seeds/idx: (S,) per-slot last token, write position,
@@ -139,7 +176,15 @@ def _decode_tick(params, pools, tok, pos, bt, temp, seeds, idx, *,
     gathered table under the position mask; inactive slots carry
     pos=0 / bt=scratch and their results are ignored host-side.
     Returns (next token per slot, updated pools); pools are DONATED —
-    the caches update in place across ticks."""
+    the caches update in place across ticks.
+
+    `attn="flash"` swaps the gather + masked_attention read for the
+    fused `paged_flash_decode` kernel (same math, no materialized
+    gathered table); "gather" stays the XLA reference the kernel is
+    pinned against. Draft rows (speculative decoding) are ordinary
+    rows at consecutive positions of a shared table: the pool write
+    happens before the read in BOTH paths, so row j's attention sees
+    rows i < j of the same tick — the single-pass verify."""
     params = T.cast_params(params, cfg.compute_dtype)
     s_rows = tok.shape[0]
     bs = pools[0]["k"].shape[2]
@@ -153,11 +198,13 @@ def _decode_tick(params, pools, tok, pos, bt, temp, seeds, idx, *,
     rows = jnp.arange(s_rows)
     blk = bt[rows, pos // bs]
     off = pos % bs
-    span = jnp.arange(w * bs)
-    valid = span[None, :] <= pos[:, None]                   # (S, W*bs)
-    if cfg.attn_window > 0:
-        valid = valid & (span[None, :] > pos[:, None] - cfg.attn_window)
-    valid = valid[:, None, None, None, :]
+    if attn != "flash":
+        span = jnp.arange(w * bs)
+        valid = span[None, :] <= pos[:, None]               # (S, W*bs)
+        if cfg.attn_window > 0:
+            valid = valid & (span[None, :]
+                             > pos[:, None] - cfg.attn_window)
+        valid = valid[:, None, None, None, :]
     new_pools = []
     for p, pool in zip(params["blocks"], pools):
         h = T._norm(p["ln1"], x, cfg)
@@ -167,7 +214,11 @@ def _decode_tick(params, pools, tok, pos, bt, temp, seeds, idx, *,
             k = _rope_rows(k, pos, cfg.rope_theta)
         pool = {**pool, **write_rows(pool, k[:, 0], v[:, 0], blk, off,
                                      quant)}
-        a = masked_attention(q, gather_table(pool, bt), valid, cfg)
+        if attn == "flash":
+            a = paged_flash_decode(q[:, 0], pool, bt, pos,
+                                   window=cfg.attn_window)
+        else:
+            a = masked_attention(q, gather_table(pool, bt), valid, cfg)
         x = x + T._dense(p["proj"], a.reshape(s_rows, 1, cfg.d_model))
         h = T._norm(p["ln2"], x, cfg)
         x, _aux = T._ffn(p, x, cfg, h)
@@ -232,7 +283,8 @@ class _Req:
                  "generated", "n_preempt", "phase", "slot", "ctx",
                  "table", "written", "admit_seq", "admit_t",
                  "queued_at", "wait_s", "first_tok_t", "last_tok",
-                 "timeline", "track", "trace_t0")
+                 "timeline", "track", "trace_t0", "n_drafted",
+                 "n_accepted", "ctx_ids", "spec_idx")
 
     def __init__(self, rid, prompt, max_new, temp, seed, arrival):
         self.rid = rid
@@ -259,6 +311,12 @@ class _Req:
         self.timeline: list[dict] = []
         self.track = None
         self.trace_t0 = None
+        # speculative decoding (schema v9): drafted/accepted tallies
+        # + the lazily-built n-gram occurrence index (`_spec_state`)
+        self.n_drafted = 0
+        self.n_accepted = 0
+        self.ctx_ids = None
+        self.spec_idx = None
 
 
 class ServingEngine:
@@ -272,10 +330,25 @@ class ServingEngine:
                  n_blocks: int = 64, block_size: int = 16,
                  max_slots: int = 4, prefill_chunk: int = 32,
                  table_bucket: int = 4, kv_quant: str = "",
+                 weight_quant: str = "", attn_impl: str = "gather",
+                 spec_k: int = 0, spec_ngram: int = 3,
                  top_k: int = 0, top_p: float = 0.0, metrics=None,
                  log_every: int = 0, clock=time.time,
                  lifecycle: bool = True, chaos_plan=None):
-        self.params = params
+        if attn_impl not in ("gather", "flash"):
+            raise ValueError(
+                f"unsupported attn_impl={attn_impl!r}; expected "
+                f"'gather' (the XLA reference) or 'flash' (the paged "
+                f"Pallas decode kernel)")
+        # quantize ONCE at init (host-side, idempotent): every tick
+        # then reads 1-byte weights through the fused-dequant matmul
+        self.params = T.quantize_weights(params, weight_quant)
+        self.weight_quant = weight_quant
+        self.attn_impl = attn_impl
+        # speculative decoding: K draft tokens per decoding request per
+        # tick, drafted by the n-gram prompt-lookup proposer
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
         self.cfg = cfg
         self.block_size = int(block_size)
         self.max_slots = int(max_slots)
@@ -300,7 +373,9 @@ class ServingEngine:
         self.chaos_plan = chaos_plan
         self.pools = init_block_pool(cfg, n_blocks, block_size, kv_quant)
         self.alloc = BlockAllocator(n_blocks)
-        self._p_bytes = param_read_bytes(params, cfg)  # constant term
+        # constant param term at the STORAGE dtypes actually served
+        # (int8/fp8 values + f32 scales when weight_quant is on)
+        self._p_bytes = param_read_bytes(self.params, cfg)
         self.slots: list[_Req | None] = [None] * self.max_slots
         self.queue: deque[_Req] = deque()
         self.results: dict[str, np.ndarray] = {}
@@ -311,7 +386,8 @@ class ServingEngine:
         self.timelines: dict[str, list] = {}
         self.counters = {"submitted": 0, "finished": 0, "preempted": 0,
                          "ticks": 0, "prefill_chunks": 0,
-                         "shed_toggles": 0}
+                         "shed_toggles": 0, "spec_drafted": 0,
+                         "spec_accepted": 0}
         # SLO load shedding (round 12, telemetry/monitor): while
         # `admission_paused`, `_admit` leaves the queue alone — running
         # requests keep every slot/block they hold and drain the
@@ -327,6 +403,15 @@ class ServingEngine:
         self._win_tokens = 0            # tokens since the last log line
         self._win_t = clock()
         self._last_touched = 0
+        self._win_drafted = 0           # spec-decode window tallies
+        self._win_accepted = 0
+        # decode-tick width buckets already executed (and so already
+        # compiled): the FIRST tick at a new width re-traces — stamped
+        # as a `table_rebucket` ledger event so attribution can book
+        # the retrace instead of leaving it unexplained; revisits hit
+        # the jit cache and stamp nothing
+        self._tick_widths: set[int] = set()
+        self._last_width = 0
 
     # ------------------------------------------------------ public API
 
@@ -574,6 +659,23 @@ class ServingEngine:
             return False
         s = self.max_slots
         bs = self.block_size
+        # speculative drafts claim the tick's FREE rows (empty slots
+        # and prefilling requests' idle rows) — occupancy is data, so
+        # drafting costs zero executables and zero extra tick time
+        drafts: dict[str, tuple] = {}
+        if self.spec_k > 0:
+            free = [i for i in range(s)
+                    if i not in {r.slot for r in actives}]
+            for r in sorted(actives, key=lambda r: r.admit_seq):
+                if not free:
+                    break
+                cap = min(self.spec_k, len(free),
+                          r.max_new - len(r.generated) - 1)
+                if cap <= 0:
+                    continue
+                d = self._grow_for_drafts(r, self._propose(r, cap))
+                if d:
+                    drafts[r.rid] = (r, [(free.pop(0), t) for t in d])
         tok = np.zeros(s, np.int32)
         pos = np.zeros(s, np.int32)
         temp = np.zeros(s, np.float32)
@@ -589,19 +691,147 @@ class ServingEngine:
             seeds[r.slot] = r.seed
             idx[r.slot] = len(r.generated)
             bt[r.slot, :len(r.table)] = r.table
+        for r, assigned in drafts.values():
+            # draft row j: the j-th draft token at position written+j,
+            # sampling at oracle token index len(generated)+j — the
+            # same fold_in schedule the solo stream uses at that index
+            for j, (row, dtok) in enumerate(assigned, start=1):
+                tok[row] = dtok
+                pos[row] = r.written + j
+                temp[row] = r.temp
+                seeds[row] = r.seed
+                idx[row] = len(r.generated) + j
+                bt[row, :len(r.table)] = r.table
+        if w not in self._tick_widths:
+            # FIRST tick at this width bucket compiles a fresh
+            # executable (geometric bucketing keeps the count O(log
+            # max_len)); later returns to the width hit the jit cache,
+            # so only first visits stamp — a phantom stamp per
+            # width flip under alternating traffic would over-book
+            # compile pauses that never happened. The warmup width
+            # (empty seen-set) is booked as compile, not a rebucket.
+            if self._tick_widths and self.metrics is not None:
+                self.metrics.log(event="ledger", kind="table_rebucket",
+                                 count=1, prev_width=self._last_width,
+                                 width=int(w),
+                                 tick=self.counters["ticks"])
+            self._tick_widths.add(w)
+        self._last_width = w
         nxt, self.pools = _decode_tick(
             self.params, self.pools, tok, pos, bt, temp, seeds, idx,
-            cfg=self.cfg, top_k=self.top_k, top_p=self.top_p)
+            cfg=self.cfg, top_k=self.top_k, top_p=self.top_p,
+            attn=self.attn_impl)
         nxt = np.asarray(nxt)
         self.counters["ticks"] += 1
         self._last_touched = sum(
-            blocks_for(r.written + 1, bs) for r in actives)
+            blocks_for(r.written + 1
+                       + len(drafts.get(r.rid, (None, ()))[1]), bs)
+            for r in actives)
+        emitted = 0
         for r in actives:
+            # speculation tallies accrue BEFORE the appends: an
+            # accepted final draft can finish the request, and the
+            # "request" record stamped at that instant must already
+            # carry this tick's drafted/accepted counts
+            assigned = drafts.get(r.rid, (None, ()))[1]
+            if assigned:
+                r.n_drafted += len(assigned)
+                self.counters["spec_drafted"] += len(assigned)
+                self._win_drafted += len(assigned)
+            tok_next = int(nxt[r.slot])
             r.written += 1
-            self._append_token(r, int(nxt[r.slot]))
-        self._win_tokens += len(actives)
+            self._append_token(r, tok_next)
+            emitted += 1
+            for row, dtok in assigned:
+                # accept while the draft equals the oracle draw; the
+                # next row's logits are then the TRUE logits at the
+                # advanced context, so its draw is the oracle's too
+                if r.rid in self.results or dtok != tok_next:
+                    break
+                tok_next = int(nxt[row])
+                r.n_accepted += 1
+                self.counters["spec_accepted"] += 1
+                self._win_accepted += 1
+                r.written += 1
+                self._append_token(r, tok_next)
+                emitted += 1
+        self._win_tokens += emitted
         self._maybe_log()
         return True
+
+    # ------------------------------------------------- spec decoding
+
+    def _propose(self, req, k: int) -> list:
+        """Self-drafting n-gram prompt-lookup proposer: find the most
+        recent EARLIER occurrence of the context's trailing n-gram
+        (longest n first, n <= spec_ngram) and draft the k tokens that
+        followed it. No draft model and no device work — the draft
+        source is the request's own prompt + generated stream, which
+        is exactly where repeated spans (code, templates, copied
+        entities) live. O(spec_ngram) dict lookups per call: the
+        occurrence index is built once per request and maintained
+        O(spec_ngram) per appended token (`_spec_note`) — a per-tick
+        rescan would cost O(context) host time per request, growing
+        with every generated token."""
+        ctx, idx = self._spec_state(req)
+        n_ctx = len(ctx)
+        for n in range(min(self.spec_ngram, n_ctx - 1), 0, -1):
+            ent = idx.get(tuple(ctx[n_ctx - n:]))
+            if ent is None:
+                continue
+            # the index's latest entry is the tail itself (indexed
+            # when its last token arrived) — the draft source is the
+            # most recent occurrence BEFORE it
+            start = ent[0] if ent[0] != n_ctx - n else ent[1]
+            if start is not None:
+                return ctx[start + n:start + n + k]
+        return []
+
+    def _spec_state(self, req) -> tuple:
+        """The request's draft-lookup state, built lazily on first
+        use: `ctx_ids` (prompt + generated as a plain list, appended
+        in `_append_token`) and `spec_idx`, mapping each n-gram tuple
+        (n <= spec_ngram) to its (latest, previous) start positions.
+        Survives preemption unchanged — eviction re-prefills the SAME
+        logical stream."""
+        if req.spec_idx is None:
+            req.ctx_ids = req.prompt.tolist() + list(req.generated)
+            req.spec_idx = {}
+            for j in range(len(req.ctx_ids)):
+                self._spec_note(req, j)
+        return req.ctx_ids, req.spec_idx
+
+    def _spec_note(self, req, j: int) -> None:
+        """Index every n-gram ending at position `j` of the context
+        (latest occurrence wins; the one it displaces is kept as the
+        'previous' slot `_propose` falls back to when latest is the
+        trailing gram itself)."""
+        ctx = req.ctx_ids
+        for n in range(1, self.spec_ngram + 1):
+            start = j - n + 1
+            if start < 0:
+                break
+            gram = tuple(ctx[start:j + 1])
+            ent = req.spec_idx.get(gram)
+            req.spec_idx[gram] = (start,
+                                  None if ent is None else ent[0])
+
+    def _grow_for_drafts(self, req, d: list) -> list:
+        """Grow `req`'s table to cover its draft rows' write positions
+        WITHOUT evicting anyone — drafts are opportunistic, so on pool
+        pressure they trim to the blocks already held instead of
+        preempting real work (contrast `_ensure_block`)."""
+        if not d:
+            return d
+        grow = blocks_for(req.written + len(d) + 1,
+                          self.block_size) - len(req.table)
+        if grow > 0:
+            try:
+                req.table.extend(self.alloc.alloc(grow))
+            except OutOfBlocks:
+                cap = len(req.table) * self.block_size - 1 - req.written
+                d = d[:max(0, cap)]
+        return d
 
     def _ensure_block(self, req) -> bool:
         """Grow `req`'s table to cover its next write position,
@@ -648,6 +878,9 @@ class ServingEngine:
 
     def _append_token(self, req, tok: int) -> None:
         req.generated.append(tok)
+        if req.spec_idx is not None:  # keep the draft index current
+            req.ctx_ids.append(tok)
+            self._spec_note(req, len(req.ctx_ids) - 1)
         req.last_tok = tok
         if req.first_tok_t is None:
             req.first_tok_t = self.clock()
@@ -683,6 +916,9 @@ class ServingEngine:
             rec["tpot_ms"] = round(
                 (now - req.first_tok_t) * 1e3 / (len(req.generated) - 1),
                 3)
+        if self.spec_k > 0:  # schema v9: per-request speculation record
+            rec["spec_drafted"] = req.n_drafted
+            rec["spec_accepted"] = req.n_accepted
         self.request_records.append(rec)
         if self.metrics is not None:
             self.metrics.log(event="request", **rec)
@@ -697,6 +933,13 @@ class ServingEngine:
             self.params, self.cfg, self._last_touched, self.block_size,
             self.max_slots, self.kv_quant, p_bytes=self._p_bytes)
         ticks_per_sec = self.log_every / dt
+        extra = {}
+        if self.spec_k > 0:  # schema v9: windowed speculation telemetry
+            extra = {"spec_drafted": self._win_drafted,
+                     "spec_accepted": self._win_accepted,
+                     "spec_accept_rate": round(
+                         self._win_accepted / self._win_drafted, 4)
+                     if self._win_drafted else 0.0}
         self.metrics.log(
             event="generate",
             tokens_per_sec=round(self._win_tokens / dt, 2),
@@ -705,6 +948,9 @@ class ServingEngine:
             free_blocks=self.alloc.n_free,
             blocks_touched=self._last_touched,
             bytes_per_tick=int(bpt),
-            hbm_gbps=round(ticks_per_sec * bpt / 1e9, 4))
+            hbm_gbps=round(ticks_per_sec * bpt / 1e9, 4),
+            **extra)
         self._win_tokens = 0
+        self._win_drafted = 0
+        self._win_accepted = 0
         self._win_t = now

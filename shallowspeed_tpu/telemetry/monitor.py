@@ -443,7 +443,12 @@ class Monitor:
                 self.sketches.observe(name, v)
         now = self._now()
         for field in ("queue_depth", "active_slots", "free_blocks",
-                      "blocks_touched", "hbm_gbps"):
+                      "blocks_touched", "hbm_gbps",
+                      # schema v9: speculative-decoding window tallies
+                      # — acceptance rate rides /status.json so a
+                      # fleet view sees whether speculation is paying
+                      "spec_drafted", "spec_accepted",
+                      "spec_accept_rate"):
             if field in rec:
                 self.serving[field] = rec[field]
         for rule in self.rules:
@@ -677,7 +682,8 @@ class Monitor:
                 if v is not None:
                     lines.append(f"# TYPE {P}{name} gauge")
                     lines.append(f"{P}{name} {v:.6g}")
-            for field in ("queue_depth", "active_slots", "free_blocks"):
+            for field in ("queue_depth", "active_slots", "free_blocks",
+                          "spec_accept_rate"):
                 v = self.serving.get(field)
                 if isinstance(v, (int, float)):
                     lines.append(f"# TYPE {P}{field} gauge")

@@ -42,6 +42,12 @@ METRICS = {
     # `value`, plus scheduler overhead, so a wide floor; rounds before
     # r07 lack the metric and pass vacuously
     "serving_tok_per_sec": (0.35, None),
+    # spec-on serving headline (round 14, the spec-on/off sweep):
+    # same dispatch noise as the spec-off number, same wide floor;
+    # additionally sensitive to the n-gram proposer's acceptance on
+    # the bench's templated prompts — a drop here means speculation
+    # stopped paying, which is exactly what the gate should catch
+    "serving_spec_tok_per_sec": (0.35, None),
 }
 
 

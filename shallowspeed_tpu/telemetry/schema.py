@@ -61,12 +61,23 @@ from pathlib import Path
 # prefill chunk k -> decoding -> preempted -> requeued -> finished)
 # that `report.request_timeline` reconstructs into per-request
 # timelines; span lines additionally allow ph "M" (Chrome metadata:
-# the named per-request trace tracks). The validator accepts ALL
-# dialects — every versioned field is optional, so committed v1-v7
-# artifacts (no version stamp / no health / overlap / attrib / wall /
-# fault / request / monitor / straggler / lifecycle fields) keep
-# validating unchanged.
-SCHEMA_VERSION = 8
+# the named per-request trace tracks); 9 = v8 plus the fast-decode
+# extension (round 14, speculative decoding in `serving/engine.py`):
+# "request" lines may carry the per-request speculation record
+# (spec_drafted / spec_accepted), "generate" tick lines grow typed
+# serving + speculation fields (queue_depth, active_slots,
+# free_blocks, blocks_touched, bytes_per_tick, hbm_gbps, spec_drafted,
+# spec_accepted, spec_accept_rate — the acceptance-rate telemetry the
+# monitor surfaces at /status.json), and "ledger" lines allow the
+# `table_rebucket` stamp's width/prev_width/tick fields (a request's
+# block table crossing a geometric width bucket re-traces the decode
+# tick; the stamp keeps attribution from booking it as unexplained).
+# The validator accepts ALL dialects — every versioned field is
+# optional, so committed v1-v8 artifacts (no version stamp / no
+# health / overlap / attrib / wall / fault / request / monitor /
+# straggler / lifecycle / speculation fields) keep validating
+# unchanged.
+SCHEMA_VERSION = 9
 
 _NUM = (int, float)
 
@@ -115,19 +126,32 @@ _METRIC_EVENTS = {
 }
 
 # optional typed fields on a "ledger" line (`fail_class`: the
-# supervisor's failure classification riding its restart stamps)
-_LEDGER_OPTIONAL = {"seconds": _NUM, "count": int, "fail_class": str}
+# supervisor's failure classification riding its restart stamps;
+# width/prev_width/tick: the v9 `table_rebucket` retrace stamp)
+_LEDGER_OPTIONAL = {"seconds": _NUM, "count": int, "fail_class": str,
+                    "width": int, "prev_width": int, "tick": int}
 
 # optional typed fields on a "fault" line
 _FAULT_OPTIONAL = {"step": int, "save": int, "seconds": _NUM,
                    "leaf": int, "fault_id": str, "point": str,
                    "path": str, "mode": str}
 
-# optional typed fields on a "request" line (schema v6). tpot_ms is
-# absent (not null) for single-token generations — there is no
-# inter-token interval to average
+# optional typed fields on a "request" line (schema v6; spec_* are the
+# v9 speculative-decoding record). tpot_ms is absent (not null) for
+# single-token generations — there is no inter-token interval to
+# average
 _REQUEST_OPTIONAL = {"tpot_ms": _NUM, "e2e_ms": _NUM, "wait_ms": _NUM,
-                     "queue_depth": int, "preempted": int}
+                     "queue_depth": int, "preempted": int,
+                     "spec_drafted": int, "spec_accepted": int}
+
+# optional typed fields on a "generate" line (schema v9: the serving
+# tick fields written since v6 become typed, plus the speculation
+# window tallies — spec_accept_rate is what /status.json surfaces)
+_GENERATE_OPTIONAL = {"queue_depth": int, "active_slots": int,
+                      "free_blocks": int, "blocks_touched": int,
+                      "bytes_per_tick": int, "hbm_gbps": _NUM,
+                      "spec_drafted": int, "spec_accepted": int,
+                      "spec_accept_rate": _NUM}
 
 # optional typed fields on the schema-v7 events
 _MONITOR_OPTIONAL = {"counters": dict, "rel_err": _NUM}
@@ -222,6 +246,12 @@ def _validate_metric(rec: dict) -> list[str]:
             if field in rec and (not isinstance(rec[field], typ)
                                  or isinstance(rec[field], bool)):
                 probs.append(f"request: field {field!r} is "
+                             f"{type(rec[field]).__name__}")
+    if ev == "generate":
+        for field, typ in _GENERATE_OPTIONAL.items():
+            if field in rec and (not isinstance(rec[field], typ)
+                                 or isinstance(rec[field], bool)):
+                probs.append(f"generate: field {field!r} is "
                              f"{type(rec[field]).__name__}")
     if ev in ("monitor", "alert", "straggler", "lifecycle"):
         opt = {"monitor": _MONITOR_OPTIONAL, "alert": _ALERT_OPTIONAL,

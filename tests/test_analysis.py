@@ -343,6 +343,92 @@ def test_overlap_rule_skips_unregistered_programs():
     assert not run_rules(probe, only=("overlap-bucket",))
 
 
+# --------------------------------------------------------- dequant fusion
+
+
+def test_dequant_rule_fires_on_materialized_dequant():
+    """The classic way to lose quantized storage: scale the upcast
+    weight BEFORE the dot — a full (K, N) dequantized copy."""
+    @jax.jit
+    def bad(x, wq, ws):
+        return x @ (wq.astype(jnp.float32) * ws)
+
+    probe = toy_probe(bad, [sds((4, 8), jnp.float32),
+                            sds((8, 16), jnp.int8),
+                            sds((16,), jnp.float32)])
+    found = highs(run_rules(probe, only=("dequant-fusion",)))
+    assert found and "dequantized copy" in found[0].message
+
+
+def test_dequant_rule_fires_on_bf16_dequant_copy():
+    """A bf16 dequant copy is still a copy (ml_dtypes floats must
+    class as floating for the size check)."""
+    @jax.jit
+    def bad(x, wq, ws):
+        return x @ (wq.astype(jnp.bfloat16) * ws.astype(jnp.bfloat16))
+
+    probe = toy_probe(bad, [sds((4, 8), jnp.float32),
+                            sds((8, 16), jnp.int8),
+                            sds((16,), jnp.float32)])
+    assert highs(run_rules(probe, only=("dequant-fusion",)))
+
+
+def test_dequant_rule_fires_on_fp8_weights():
+    @jax.jit
+    def bad(x, wq, ws):
+        return x @ (wq.astype(jnp.float32) * ws)
+
+    fp8 = getattr(jnp, "float8_e4m3fn", None)
+    if fp8 is None:
+        pytest.skip("no float8_e4m3fn in this jax build")
+    probe = toy_probe(bad, [sds((4, 8), jnp.float32),
+                            sds((8, 16), fp8),
+                            sds((16,), jnp.float32)])
+    assert highs(run_rules(probe, only=("dequant-fusion",)))
+
+
+def test_dequant_rule_quiet_on_fused_form():
+    """`dequant_matmul` is the clean fixture: the value upcast feeds
+    the dot directly (folded into the operand load), the scale lands
+    on the f32 accumulator."""
+    from shallowspeed_tpu.ops.matmul import dequant_matmul
+
+    @jax.jit
+    def clean(x, wq, ws):
+        return dequant_matmul(x, wq, ws)
+
+    probe = toy_probe(clean, [sds((4, 8), jnp.float32),
+                              sds((8, 16), jnp.int8),
+                              sds((16,), jnp.float32)])
+    assert not run_rules(probe, only=("dequant-fusion",))
+
+
+def test_dequant_rule_exempts_gathered_int8_kv_views():
+    """int8 KV reads go through a GATHER before their upcast (the
+    paged read path); the gather breaks the weight-view chain, so the
+    reference attention's gathered-view casts are not weight dequants
+    and must not fire."""
+    @jax.jit
+    def kv_read(q, pool, bt):
+        g = pool[bt]                       # (rows, W, H, bs, hd) int8
+        g = jnp.swapaxes(g, 1, 2).reshape(2, 2, 16, 4)
+        return jnp.einsum("rhd,rhkd->rhk", q, g.astype(jnp.float32))
+
+    probe = toy_probe(kv_read, [sds((2, 2, 4), jnp.float32),
+                                sds((8, 2, 8, 4), jnp.int8),
+                                sds((2, 2), jnp.int32)])
+    assert not run_rules(probe, only=("dequant-fusion",))
+
+
+def test_dequant_rule_clean_on_quantized_decode_tick():
+    """The live target: the serving decode tick at full quantization
+    (int8 weights + int8 KV + the paged flash kernel) never
+    materializes a dequantized weight copy. (Also exercised by the
+    parametrized clean gate below via the 'serving' target.)"""
+    results = analysis.analyze("serving", only=("dequant-fusion",))
+    assert all(not fs for fs in results.values()), results
+
+
 # ----------------------------------------------- the tier-1 clean gate
 
 

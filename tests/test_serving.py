@@ -457,6 +457,411 @@ def test_request_summary_percentiles():
     assert s1["tpot_ms_p50"] is None and s1["ttft_ms_p50"] == 5.0
 
 
+# ------------------------------------ fast decode path (round 14)
+#
+# Three composable levers, each gated separately: quantized weight
+# storage with fused dequant, the paged Pallas flash-decode kernel,
+# and self-drafting speculative decoding. The oracles are the same
+# ones PR 7 pinned: solo `generate()` streams and the gather_table
+# XLA read path.
+
+
+def test_quantize_weights_modes_and_errors(params):
+    with pytest.raises(ValueError, match="weight_quant"):
+        T.quantize_weights(params, "int4")
+    assert T.quantize_weights(params, "") is params
+    qp = T.quantize_weights(params, "int8")
+    assert T.weight_quant_mode(qp) == "int8"
+    assert T.weight_quant_mode(params) == ""
+    blk = qp["blocks"][0]
+    assert blk["qkv"]["Wq"].dtype == jnp.int8
+    assert blk["qkv"]["Ws"].dtype == jnp.float32
+    assert blk["qkv"]["Ws"].shape == (blk["qkv"]["Wq"].shape[1],)
+    assert "W" not in blk["qkv"] and "b" in blk["qkv"]
+    # norms and embeddings stay unquantized (O(d) / gathered rows)
+    assert "g" in blk["ln1"] and qp["tok_emb"].dtype == params[
+        "tok_emb"].dtype
+    # idempotent: re-quantizing an already-quantized tree is a no-op
+    qp2 = T.quantize_weights(qp, "int8")
+    np.testing.assert_array_equal(np.asarray(qp2["blocks"][0]["qkv"][
+        "Wq"]), np.asarray(blk["qkv"]["Wq"]))
+
+
+def test_cast_params_preserves_quantized_storage(params):
+    """The mixed-precision boundary must not rewiden quantized
+    leaves: Wq stays int8/fp8 (a bf16 cast would be the materialized
+    dequant copy the analysis rule flags) and the f32 scales stay f32
+    (numerics, not bulk bytes)."""
+    qp = T.quantize_weights(params, "int8")
+    cast = jax.eval_shape(lambda p: T.cast_params(p, jnp.bfloat16), qp)
+    blk = cast["blocks"][0]
+    assert blk["qkv"]["Wq"].dtype == jnp.int8
+    assert blk["qkv"]["Ws"].dtype == jnp.float32
+    assert blk["qkv"]["b"].dtype == jnp.bfloat16   # plain floats cast
+    assert cast["tok_emb"].dtype == jnp.bfloat16
+
+
+def test_dequant_matmul_matches_explicit_dequant():
+    """The fused form computes the same number as the materialized
+    dequant (per-out-channel scale is constant along K, so scaling
+    the accumulator is exact reassociation)."""
+    from shallowspeed_tpu.ops.matmul import dequant_matmul
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(5, 16)), jnp.float32)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    ws = (np.abs(w).max(axis=0) / 127.0).astype(np.float32)
+    wq = np.clip(np.round(w / ws), -127, 127).astype(np.int8)
+    ref = x @ jnp.asarray(wq.astype(np.float32) * ws)
+    got = dequant_matmul(x, jnp.asarray(wq), jnp.asarray(ws))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_quantized_weight_serving_matches_solo_stream(params, mode):
+    """A request served with quantized weight storage reproduces the
+    solo `generate()` stream over the SAME quantized tree — the
+    fused-dequant tick is numerics-equal to the contiguous path's
+    dequant-dispatching `_dense`, greedy and sampled."""
+    if mode == "fp8" and T._FP8_DTYPE is None:
+        pytest.skip("no float8_e4m3fn in this jax build")
+    qp = jax.device_put(T.quantize_weights(params, mode))
+    prompt = toks(13, t=14)
+    for kwargs in ({"temperature": 0.0}, {"temperature": 1.0, "seed": 9}):
+        ref = np.asarray(generate(qp, prompt[None], CFG, 8,
+                                  **kwargs))[0]
+        eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                            max_slots=4, prefill_chunk=16,
+                            weight_quant=mode)
+        eng.submit(prompt, 8, temperature=kwargs.get("temperature", 0.0),
+                   seed=kwargs.get("seed", 0), rid="q")
+        np.testing.assert_array_equal(eng.run()["q"], ref,
+                                      err_msg=f"{mode} {kwargs}")
+
+
+def test_int8_weight_tick_bytes_beat_bf16_baseline():
+    """THE byte-model acceptance gate: the int8-weight decode tick's
+    read bytes price at <= 0.55x the bf16 baseline per
+    `paged_read_bytes_per_tick`, and the param term is pinned EXACTLY
+    against the traced tick's own param invar bytes (walker
+    `aval_bytes` over `jax.make_jaxpr` on eval_shape structs — no
+    device copies), int8 weights + f32 scales + bf16 embeddings +
+    int8 KV mixed in one model."""
+    from shallowspeed_tpu.analysis.walker import aval_bytes
+    from shallowspeed_tpu.serving.cache import param_read_bytes
+    from shallowspeed_tpu.serving.engine import _decode_tick
+
+    cfg = T.TransformerConfig(vocab=64, d_model=128, n_heads=4,
+                              n_layers=2, max_seq=64,
+                              compute_dtype=jnp.bfloat16)
+    params = T.init(cfg, seed=0)
+    qp = T.quantize_weights(params, "int8")
+    bs, touched, rows = 8, 2, 4
+    base = paged_read_bytes_per_tick(params, cfg, touched, bs, rows,
+                                     kv_quant="int8")
+    fast = paged_read_bytes_per_tick(qp, cfg, touched, bs, rows,
+                                     kv_quant="int8")
+    assert fast <= 0.55 * base, (fast, base, fast / base)
+
+    # walker pin: trace the tick over the post-cast tree (the dtypes
+    # the engine actually serves — `cast_params` inside is then the
+    # identity) and compare invar bytes term by term
+    cast = jax.eval_shape(
+        lambda p: T.cast_params(p, cfg.compute_dtype), qp)
+    pools = jax.eval_shape(
+        lambda: init_block_pool(cfg, 8, bs, kv_quant="int8"))
+    s, w = rows, 4
+    i32 = lambda *sh: jax.ShapeDtypeStruct(sh, np.int32)  # noqa: E731
+    closed = jax.make_jaxpr(
+        lambda p, pl, tok, pos, bt, temp, seeds, idx: _decode_tick(
+            p, pl, tok, pos, bt, temp, seeds, idx, cfg=cfg, top_k=0,
+            top_p=0.0))(
+        cast, pools, i32(s), i32(s), i32(s, w),
+        jax.ShapeDtypeStruct((s,), np.float32),
+        jax.ShapeDtypeStruct((s,), np.uint32), i32(s))
+    n_param = len(jax.tree_util.tree_leaves(cast))
+    traced_param_bytes = sum(aval_bytes(v.aval)
+                             for v in closed.jaxpr.invars[:n_param])
+    assert traced_param_bytes == param_read_bytes(qp, cfg)
+    # ...and the per-block KV term equals one traced pool block's bytes
+    pool_leaves = jax.tree_util.tree_leaves(pools)
+    per_block_traced = sum(aval_bytes(l) for l in pool_leaves) \
+        // (cfg.n_layers * 8)
+    model_per_block = (fast - param_read_bytes(qp, cfg) - rows * 4) \
+        // (cfg.n_layers * touched)
+    assert model_per_block == per_block_traced
+
+
+def test_flash_decode_engine_matches_solo_stream(params):
+    """attn_impl='flash' (the paged Pallas kernel, interpret mode on
+    CPU) reproduces the gather-path solo stream token-for-token —
+    kernel-vs-reference logits sit at ~1e-7, far inside sampling's
+    decision boundaries on this model."""
+    prompt = toks(17, t=21)
+    ref = solo(params, prompt, 10, temperature=0.0)
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16,
+                        attn_impl="flash")
+    eng.submit(prompt, 10, rid="q")
+    np.testing.assert_array_equal(eng.run()["q"], ref)
+    with pytest.raises(ValueError, match="attn_impl"):
+        ServingEngine(params, CFG, n_blocks=8, attn_impl="paged")
+
+
+def test_flash_decode_full_stack_matches_int8_oracle(params):
+    """All three levers at once (int8 weights + int8 KV + flash
+    kernel) still reproduce the solo oracle over the same quantized
+    tree and int8 cache — the levers compose without drift."""
+    qp = jax.device_put(T.quantize_weights(params, "int8"))
+    prompt = toks(19, t=18)
+    ref = np.asarray(generate(qp, prompt[None], CFG, 9,
+                              temperature=0.0, kv_quant="int8"))[0]
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16,
+                        weight_quant="int8", kv_quant="int8",
+                        attn_impl="flash", spec_k=2)
+    eng.submit(prompt, 9, rid="q")
+    np.testing.assert_array_equal(eng.run()["q"], ref)
+
+
+# --------------------------------------------- speculative decoding
+
+
+def spec_prompt(seed=0, t=18):
+    """Self-similar prompt (repeated motif): gives the n-gram
+    prompt-lookup proposer something to draft from."""
+    rng = np.random.default_rng(seed)
+    motif = rng.integers(0, 64, max(2, t // 3)).astype(np.int32)
+    return np.concatenate([motif] * (-(-t // len(motif))))[:t]
+
+
+def test_spec_decode_temp0_stream_identical_and_accepts(params):
+    """Temp-0 spec-on streams are token-identical to solo
+    `generate()` for EVERY prompt, and on at least one of the probed
+    prompts speculation accepts drafts — which must show up as a tick
+    count below max_new's one-token-per-tick floor (accepted drafts
+    emit extra tokens per tick)."""
+    accepted_somewhere = False
+    for seed in (0, 5, 9, 23):
+        prompt = spec_prompt(seed, t=18)
+        ref = solo(params, prompt, 16, temperature=0.0)
+        eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                            max_slots=4, prefill_chunk=16, spec_k=3)
+        eng.submit(prompt, 16, rid="q")
+        res = eng.run()
+        np.testing.assert_array_equal(res["q"], ref,
+                                      err_msg=f"seed={seed}")
+        acc = eng.counters["spec_accepted"]
+        assert eng.counters["spec_drafted"] >= acc
+        if acc > 0:
+            accepted_somewhere = True
+            # 16 tokens, 1 sampled at prefill -> 15 ticks unsped
+            assert eng.counters["ticks"] < 15
+        assert eng.alloc.n_free == eng.alloc.n_usable
+    assert accepted_somewhere, (
+        "no probed prompt produced an accepted draft — the proposer "
+        "or the accept rule broke")
+
+
+def test_spec_decode_seeded_sampling_parity(params):
+    """The oracle-sampler parity pin: under seeded SAMPLING the
+    spec-on stream equals the solo stream too — every emitted token
+    is the oracle draw `sample(fold_in(PRNGKey(seed), i), logits_i)`
+    at its own index (the accept rule re-draws the oracle sample, so
+    the output distribution is the oracle sampler's by construction,
+    not merely in expectation)."""
+    prompt = spec_prompt(7, t=15)
+    for seed, temp in ((3, 1.0), (11, 0.7)):
+        ref = solo(params, prompt, 12, temperature=temp, seed=seed)
+        eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                            max_slots=4, prefill_chunk=16, spec_k=3)
+        eng.submit(prompt, 12, temperature=temp, seed=seed, rid="q")
+        np.testing.assert_array_equal(eng.run()["q"], ref,
+                                      err_msg=f"seed={seed}")
+
+
+def test_spec_decode_concurrent_and_under_preemption(params):
+    """Spec-on continuous batching under pool pressure: concurrent
+    requests (drafts competing for free rows) with forced eviction
+    still reproduce every solo stream, and the allocator balances at
+    drain — draft-grown tables free cleanly."""
+    reqs = {k: (spec_prompt(40 + i, t=20), 12)
+            for i, k in enumerate("abc")}
+    oracle = {k: solo(params, p, mn, temperature=0.0)
+              for k, (p, mn) in reqs.items()}
+    # tight pool: 13 usable blocks * 8 = 104 positions < 3 * 32
+    eng = ServingEngine(params, CFG, n_blocks=14, block_size=8,
+                        max_slots=4, prefill_chunk=16, spec_k=3)
+    for k, (p, mn) in reqs.items():
+        eng.submit(p, mn, rid=k)
+    res = eng.run()
+    for k in reqs:
+        np.testing.assert_array_equal(res[k], oracle[k], err_msg=k)
+    assert eng.alloc.n_free == eng.alloc.n_usable
+    assert eng.alloc.n_allocated == 0
+
+
+def test_spec_decode_zero_new_executables(params):
+    """Drafts are DATA in rows that already executed empty: after
+    spec-off warmup over the same width buckets, turning speculation
+    on compiles nothing new."""
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    eng.submit(spec_prompt(1, t=18), 10, rid="w0")
+    eng.run()
+    warm = eng.executable_counts()
+    eng2 = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                         max_slots=4, prefill_chunk=16, spec_k=3)
+    eng2.submit(spec_prompt(2, t=18), 10, rid="s0")
+    eng2.submit(spec_prompt(3, t=12), 8, rid="s1")
+    eng2.run()
+    assert eng2.counters["spec_drafted"] > 0
+    assert eng2.executable_counts() == warm, (
+        f"speculation recompiled: {warm} -> {eng2.executable_counts()}")
+
+
+def test_spec_telemetry_schema_v9_and_status_surface(params, tmp_path):
+    """Speculation telemetry rides the monitor plane: request lines
+    carry the per-request drafted/accepted record, generate lines the
+    windowed acceptance rate (all schema-v9-valid), and the monitor
+    surfaces spec_accept_rate in /status.json's serving block and
+    /metrics."""
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.telemetry import schema
+    from shallowspeed_tpu.telemetry.monitor import Monitor
+
+    assert schema.SCHEMA_VERSION >= 9
+    path = tmp_path / "spec.jsonl"
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16, spec_k=3,
+                        metrics=MetricsLogger(path, kind="serve"),
+                        log_every=2)
+    eng.submit(spec_prompt(21, t=18), 12, rid="a")
+    eng.run()
+    assert schema.validate_file(path) == []
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    req = next(r for r in recs if r.get("event") == "request")
+    assert req["spec_drafted"] >= req["spec_accepted"] >= 0
+    assert req["spec_drafted"] == eng.counters["spec_drafted"]
+    gens = [r for r in recs if r.get("event") == "generate"]
+    assert gens and all("spec_accept_rate" in g for g in gens)
+    mon = Monitor()
+    for r in recs:
+        mon.note_line(r)
+    srv = mon.status()["serving"]
+    assert "spec_accept_rate" in srv
+    assert "spec_accept_rate" in mon.prometheus()
+    # malformed speculation fields are rejected
+    assert schema.validate_line(
+        {"event": "request", "id": "x", "ttft_ms": 1.0, "tokens_in": 1,
+         "tokens_out": 1, "spec_drafted": "many"}) != []
+    assert schema.validate_line(
+        {"event": "generate", "tokens_per_sec": 1.0,
+         "spec_accept_rate": "high"}) != []
+
+
+# ------------------------------- satellites: rebucket + atomicity
+
+
+def test_rebucket_ledger_and_log_executable_growth(params, tmp_path):
+    """A long-running request crossing geometric table-width buckets
+    re-traces the decode tick O(log max_len) times — not O(len) — and
+    every crossing stamps a `table_rebucket` ledger event, so
+    attribution never books the retrace as unexplained."""
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.telemetry import schema
+    from shallowspeed_tpu.serving.engine import _decode_tick
+
+    path = tmp_path / "rebucket.jsonl"
+    before = int(_decode_tick._cache_size())
+    cfg2 = T.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                               n_layers=2, max_seq=256)
+    p2 = jax.device_put(T.init(cfg2, seed=3))
+    # block_size 4, bucket 1: a 4 + 60 = 64-position request walks
+    # widths 1 -> 2 -> 4 -> 8 -> 16 (forced boundary crossings)
+    eng = ServingEngine(p2, cfg2, n_blocks=32, block_size=4,
+                        max_slots=2, prefill_chunk=8, table_bucket=1,
+                        metrics=MetricsLogger(path, kind="serve"))
+    eng.submit(toks(2, t=4), 60, rid="long")
+    eng.run()
+    grown = int(_decode_tick._cache_size()) - before
+    # O(log): 5 distinct widths for 16 blocks, never one-per-block
+    assert 1 <= grown <= 6, grown
+    assert schema.validate_file(path) == []
+    stamps = [json.loads(l) for l in path.read_text().splitlines()
+              if '"table_rebucket"' in l]
+    assert stamps, "no table_rebucket ledger stamp at the crossing"
+    for s in stamps:
+        assert s["event"] == "ledger" and s["width"] != s["prev_width"]
+    # crossings observed = distinct consecutive width changes >= grown-1
+    assert len(stamps) >= grown - 1
+
+
+def test_alloc_partial_failure_is_atomic():
+    """The all-or-nothing claim in `BlockAllocator.alloc`'s docstring,
+    pinned: a failing alloc leaves n_free AND the allocated set
+    unchanged (no leaked ids), and the free list still serves the
+    original capacity afterwards."""
+    a = BlockAllocator(6)               # 5 usable
+    got = a.alloc(2)
+    free_before, alloc_before = a.n_free, a.n_allocated
+    for ask in (4, 100):
+        with pytest.raises(OutOfBlocks):
+            a.alloc(ask)
+        assert a.n_free == free_before
+        assert a.n_allocated == alloc_before
+    rest = a.alloc(3)                   # the full remainder still works
+    assert len(set(got) | set(rest)) == 5
+    a.free(got + rest)
+    assert a.n_free == a.n_usable
+
+
+def test_write_rows_scratch_sink_isolation():
+    """Pad/inactive rows steered to the scratch block never corrupt
+    live reads: writes to SCRATCH_BLOCK land (possibly colliding) in
+    block 0 only, every other block is bit-unchanged, and a gathered
+    table (which by contract never contains block 0) reads back
+    exactly what was written before the scratch traffic."""
+    from shallowspeed_tpu.serving.cache import (SCRATCH_BLOCK,
+                                                gather_table, write_rows)
+
+    cfg = CFG
+    bs = 8
+    pool = init_block_pool(cfg, 8, bs)[0]
+    rng = np.random.default_rng(2)
+    k1 = jnp.asarray(rng.normal(size=(1, cfg.kv_heads, cfg.head_dim)),
+                     jnp.float32)
+    v1 = jnp.asarray(rng.normal(size=(1, cfg.kv_heads, cfg.head_dim)),
+                     jnp.float32)
+    pool = write_rows(pool, k1, v1, jnp.asarray([3]), jnp.asarray([5]),
+                      quant=False)
+    live_before = {n: np.asarray(l) for n, l in pool.items()}
+    bt = jnp.asarray([[3, 1]], jnp.int32)
+    view_before = {n: np.asarray(l)
+                   for n, l in gather_table(pool, bt).items()}
+    # a burst of scratch writes, including COLLIDING offsets (three
+    # rows, same block 0, same offset — the duplicate-scatter winner
+    # is unspecified and must not matter)
+    ks = jnp.asarray(rng.normal(size=(3, cfg.kv_heads, cfg.head_dim)),
+                     jnp.float32)
+    vs = jnp.asarray(rng.normal(size=(3, cfg.kv_heads, cfg.head_dim)),
+                     jnp.float32)
+    pool = write_rows(pool, ks, vs,
+                      jnp.full((3,), SCRATCH_BLOCK, jnp.int32),
+                      jnp.asarray([0, 0, 4]), quant=False)
+    for name, leaf in pool.items():
+        np.testing.assert_array_equal(
+            np.asarray(leaf)[1:], live_before[name][1:],
+            err_msg=f"{name}: scratch write leaked past block 0")
+    view_after = gather_table(pool, bt)
+    for name in view_before:
+        np.testing.assert_array_equal(
+            np.asarray(view_after[name]), view_before[name],
+            err_msg=f"{name}: gathered read changed after scratch "
+                    f"traffic")
+
+
 def test_paged_read_bytes_per_tick_model(params):
     """The live-blocks HBM model: params once + touched blocks' K/V
     (+ int8 scales) + token ids — the serving generalization of
