@@ -769,6 +769,74 @@ def bench_serving() -> dict:
         return {"serving_error": repr(e)[:200]}
 
 
+def bench_fleet() -> dict:
+    """Fleet offered-load sweep (round 15, `serving/router.py`): the
+    SLO-aware router over TWO in-process `ServingEngine` replicas,
+    served the same self-similar request mix as `bench_serving` at
+    increasing offered load. Records per level the aggregate fleet
+    decode tok/s and the router-observed (fleet-edge) p50 ttft; the
+    headline `fleet_tok_per_sec` (best level) joins the `--regress`
+    noise-band gate next to the single-engine `serving_tok_per_sec`,
+    so routing overhead that starts eating the fleet's throughput
+    fails the gate even when each engine alone still benches clean.
+    In-process replicas keep the bench robust (no subprocess spawn
+    variance); the dispatch/failover/scale logic exercised is the
+    same code the cross-process driver runs. Never raises — a failure
+    lands as fleet_error in the JSON line."""
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.serving import ServingEngine
+    from shallowspeed_tpu.serving.router import InProcessReplica, Router
+
+    try:
+        cfg = T.TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                                  n_layers=2, max_seq=256)
+        params = jax.device_put(T.init(cfg, seed=0))
+        lens = [8, 20, 33, 48]
+        max_new = 24
+
+        def factory(name):
+            return ServingEngine(params, cfg, n_blocks=96,
+                                 block_size=16, max_slots=8,
+                                 prefill_chunk=32)
+
+        def prompt(i):
+            t = lens[i % len(lens)]
+            motif = np.random.default_rng([11, i]).integers(
+                0, cfg.vocab, max(2, t // 3)).astype(np.int32)
+            reps = -(-t // motif.shape[0])
+            return np.concatenate([motif] * reps)[:t]
+
+        def offer(n):
+            router = Router(
+                lambda name: InProcessReplica(name, factory),
+                n_replicas=2, request_timeout=120.0)
+            for i in range(n):
+                router.submit(prompt(i), max_new, rid=f"f{n}_{i}")
+            t0 = time.perf_counter()
+            router.run(max_wall=300.0)
+            wall = time.perf_counter() - t0
+            toks = sum(r["tokens_out"] for r in router.records
+                       if r["status"] == "done")
+            ttfts = [r["ttft_ms"] for r in router.records
+                     if "ttft_ms" in r]
+            return {"offered": n, "wall_s": round(wall, 3),
+                    "tok_per_sec": round(toks / wall, 2),
+                    "ttft_p50_ms": round(float(np.median(ttfts)), 2)
+                    if ttfts else None,
+                    "routes": router.counters["routes"]}
+
+        offer(4)                     # compile warmup (excluded)
+        levels = [offer(n) for n in (2, 8, 16)]
+        return {"fleet_case": {"levels": levels, "replicas": 2,
+                               "block_size": 16, "slots": 8},
+                "fleet_tok_per_sec": max(lv["tok_per_sec"]
+                                         for lv in levels)}
+    except Exception as e:  # pragma: no cover — keep the headline robust
+        return {"fleet_error": repr(e)[:200]}
+
+
 def pinned_baseline() -> float | None:
     """The once-recorded NumPy throughput (BASELINE.json) — the stable
     denominator for vs_baseline (VERDICT r1: a re-measured baseline made
@@ -828,6 +896,7 @@ def main():
     out.update(bench_overlap())
     out.update(bench_attribution())
     out.update(bench_serving())
+    out.update(bench_fleet())
     print(json.dumps(out))
 
 

@@ -82,7 +82,24 @@ def parse_args(argv=None):
     s.add_argument("--top-k", type=int, default=0)
     s.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--requests", default="-",
-                   help="JSONL request file, or - for stdin")
+                   help="JSONL request file, or - for stdin (ignored "
+                        "under --serve unless explicitly set)")
+    p.add_argument("--serve", action="store_true",
+                   help="replica mode: stay up and accept requests "
+                        "over HTTP (POST /submit, GET /requests, "
+                        "POST /drain on the monitor endpoint — "
+                        "--monitor-port defaults to 0) until a drain "
+                        "completes; the surface a fleet router "
+                        "(router.py) drives")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="with --serve: typed EngineOverloaded "
+                        "rejection past this many queued+running "
+                        "requests (backpressure, not silent growth)")
+    p.add_argument("--heartbeat-file", default=None,
+                   help="liveness+health beat file (written ~5 Hz by "
+                        "the serve loop; a chaos freeze fault stops "
+                        "it) — the router's hang detection reads its "
+                        "mtime, like the elastic supervisor's")
     p.add_argument("--log-file", default=None,
                    help="metrics JSONL (request/generate events)")
     p.add_argument("--log-every", type=int, default=16,
@@ -196,7 +213,12 @@ def main(argv=None) -> int:
         params = checkpoint.restore(args.ckpt)["params"]
     else:
         params = jax.device_put(T.init(cfg, seed=args.init_seed))
-    reqs = load_requests(args.requests, cfg.vocab)
+    # replica mode: requests arrive over HTTP; the default "-" must
+    # not block on a subprocess's empty stdin
+    reqs = ([] if args.serve and args.requests == "-"
+            else load_requests(args.requests, cfg.vocab))
+    if args.serve and args.monitor_port is None:
+        args.monitor_port = 0
     run_info = dict(kind="serve", vocab=cfg.vocab,
                     d_model=cfg.d_model, n_layers=cfg.n_layers,
                     n_blocks=args.n_blocks, block_size=args.block_size,
@@ -228,11 +250,19 @@ def main(argv=None) -> int:
     # live telemetry plane: /status.json + /metrics endpoint, SLO
     # burn-rate alerts (optionally shedding load via Engine.on_alert),
     # anomaly flight recorder — all fed by the same metrics lines the
-    # JSONL gets (MetricsLogger.monitor)
+    # JSONL gets (MetricsLogger.monitor). In --serve mode the request
+    # gateway is grafted onto the SAME endpoint (POST /submit, GET
+    # /requests, POST /drain), so one registered URL serves both the
+    # fleet's observation polls and the router's dispatch.
     from shallowspeed_tpu.telemetry.monitor import (close_monitor,
                                                     from_args)
 
-    mon, server = from_args(args, metrics)
+    gateway = None
+    if args.serve:
+        from shallowspeed_tpu.serving.router import RequestGateway
+
+        gateway = RequestGateway(max_queue=args.max_queue)
+    mon, server = from_args(args, metrics, extra=gateway)
     if server is not None:
         print(json.dumps({"event": "monitor_listening",
                           "url": server.url("/status.json")}),
@@ -267,9 +297,25 @@ def main(argv=None) -> int:
     t0 = time.time()
     i = 0
     reported: set[str] = set()
+    drained_clean = False
+    last_hb = 0.0
     try:
-        while i < len(reqs) or eng.pending():
+        while True:
             now = time.time() - t0
+            if args.heartbeat_file and time.time() - last_hb > 0.2 \
+                    and not chaos.heartbeat_frozen():
+                # liveness + health beat (~5 Hz between engine steps):
+                # the router's hang detection reads the mtime exactly
+                # like the elastic supervisor's — a chaos freeze fault
+                # stops the beats while the loop keeps serving, which
+                # is the hang drill
+                from shallowspeed_tpu.elastic import write_heartbeat
+
+                try:
+                    write_heartbeat(args.heartbeat_file, "ok")
+                except OSError:
+                    pass
+                last_hb = time.time()
             while i < len(reqs) and reqs[i]["at"] <= now:
                 r = reqs[i]
                 i += 1
@@ -284,17 +330,50 @@ def main(argv=None) -> int:
                     print(json.dumps(
                         {"event": "error", "id": r["id"],
                          "error": f"{type(e).__name__}: {e}"}))
+            if gateway is not None:
+                gateway.pump(eng)
             if eng.pending():
                 eng.step()
             elif i < len(reqs):
                 time.sleep(min(0.05, max(0.0, reqs[i]["at"] - now)))
+            elif gateway is not None \
+                    and not gateway.drain_requested:
+                time.sleep(0.02)        # idle replica: await HTTP work
+            if gateway is not None:
+                gateway.publish(eng)
             for rec in eng.request_records[len(reported):]:
                 reported.add(rec["id"])
                 print(json.dumps({
                     "event": "result", "id": rec["id"],
                     "tokens": [int(t) for t in eng.results[rec["id"]]],
                     "ttft_ms": rec["ttft_ms"],
-                    "tpot_ms": rec.get("tpot_ms")}))
+                    "tpot_ms": rec.get("tpot_ms")}), flush=True)
+            if gateway is not None:
+                if gateway.drain_requested and gateway.idle() \
+                        and eng.drain():
+                    drained_clean = True
+                    break
+            elif i >= len(reqs) and not eng.pending():
+                break
+        if drained_clean and args.fleet_register and server is not None:
+            # clean drain completes with DEREGISTRATION — a drained
+            # replica must not linger in the fleet as "unreachable",
+            # burning availability forever (the old one-way register)
+            import urllib.request
+
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    args.fleet_register.rstrip("/") + "/deregister",
+                    data=json.dumps({
+                        "url": server.url("/status.json"),
+                        "name": args.replica or None}).encode(),
+                    headers={"Content-Type": "application/json"}),
+                    timeout=5).read()
+            except Exception as e:
+                print(json.dumps({"event": "error",
+                                  "error": f"fleet deregister failed: "
+                                           f"{type(e).__name__}: {e}"}),
+                      flush=True)
     finally:
         # reached on clean drain AND on the SIGTERM SystemExit: the
         # summary line + the monitor's final sketch snapshot land in
@@ -314,6 +393,7 @@ def main(argv=None) -> int:
             "spec_drafted": eng.counters["spec_drafted"],
             "spec_accepted": eng.counters["spec_accepted"],
             "pending_at_exit": eng.pending(),
+            "drained": drained_clean,
             "executables": eng.executable_counts(),
             "blocks_free_at_drain":
                 f"{eng.alloc.n_free}/{eng.alloc.n_usable}",

@@ -409,6 +409,32 @@ class FaultPlan:
         self._fire(f, save=n, path=str(target), mode=mode)
 
 
+def parse_fleet_spec(spec: str) -> dict:
+    """Per-replica chaos plans for fleet drills: the router driver's
+    ``--chaos-fleet 'r0=kill@6;r1=stall@3:0.5'`` maps replica NAMES to
+    ordinary plan specs — a drill targets one member of a fleet, not
+    every process that happens to share the environment. Each
+    sub-spec is validated eagerly (fail at arg time, not when the
+    replica finally spawns); returns {name: spec}."""
+    out: dict[str, str] = {}
+    for tok in spec.split(";"):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, eq, sub = tok.partition("=")
+        if not eq or not name.strip() or not sub.strip():
+            raise ValueError(
+                f"bad fleet chaos token {tok!r} (want "
+                f"'replica=plan', e.g. 'r0=kill@6;r1=stall@3:0.5')")
+        for t in sub.split(","):
+            if t.strip():
+                _parse_token(t)       # typed error on a bad sub-plan
+        out[name.strip()] = sub.strip()
+    if not out:
+        raise ValueError(f"empty fleet chaos spec {spec!r}")
+    return out
+
+
 # --------------------------------------------------- module-level plan
 #
 # One plan per process: the drivers configure it from --chaos (or the

@@ -78,6 +78,19 @@ FAIL_CLASSES = ("crash", "hang", "numeric", "corrupt_ckpt")
 EXIT_CORRUPT_CKPT = 65
 
 
+def classify_exit(code: int) -> str | None:
+    """Failure class of a child exit code: None for a clean exit,
+    "corrupt_ckpt" for the EXIT_CORRUPT_CKPT contract, "crash" for
+    everything else (nonzero exits AND outside signals). The one
+    exit-code taxonomy shared by the training supervisors here and
+    the serving fleet router (serving/router.ReplicaProc)."""
+    if code == 0:
+        return None
+    if code == EXIT_CORRUPT_CKPT:
+        return "corrupt_ckpt"
+    return "crash"
+
+
 # --------------------------------------------------- heartbeat status
 #
 # The heartbeat file is liveness AND health (round 7): its mtime is the
@@ -316,10 +329,7 @@ class Supervisor:
         while True:
             code = child.poll()
             if code is not None:
-                cls = (None if code == 0
-                       else "corrupt_ckpt" if code == EXIT_CORRUPT_CKPT
-                       else "crash")
-                return code, time.monotonic() - t0, cls
+                return code, time.monotonic() - t0, classify_exit(code)
             if self.heartbeat_file:
                 status = read_heartbeat_status(self.heartbeat_file)
                 if status.startswith("dead"):
@@ -713,10 +723,8 @@ class GangSupervisor(Supervisor):
                     self.log(f"[elastic] gang member {bad} exited "
                              f"{codes[bad]} — killing the gang")
                     self._kill_gang(children)
-                    cls = ("corrupt_ckpt"
-                           if codes[bad] == EXIT_CORRUPT_CKPT
-                           else "crash")
-                    return codes[bad], time.monotonic() - t0, cls
+                    return (codes[bad], time.monotonic() - t0,
+                            classify_exit(codes[bad]) or "crash")
                 if all(c == 0 for c in codes):
                     return 0, time.monotonic() - t0, None
                 if self.hang_timeout is not None:

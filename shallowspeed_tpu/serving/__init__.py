@@ -13,9 +13,9 @@ from shallowspeed_tpu.serving.cache import (BlockAllocator,  # noqa: F401
                                             OutOfBlocks, blocks_for,
                                             init_block_pool,
                                             paged_read_bytes_per_tick)
-from shallowspeed_tpu.serving.engine import (ServingEngine,  # noqa: F401
-                                             table_width)
+from shallowspeed_tpu.serving.engine import (EngineDraining,  # noqa: F401
+                                             ServingEngine, table_width)
 
-__all__ = ["BlockAllocator", "OutOfBlocks", "ServingEngine",
-           "blocks_for", "init_block_pool", "paged_read_bytes_per_tick",
-           "table_width"]
+__all__ = ["BlockAllocator", "EngineDraining", "OutOfBlocks",
+           "ServingEngine", "blocks_for", "init_block_pool",
+           "paged_read_bytes_per_tick", "table_width"]

@@ -117,6 +117,24 @@ from shallowspeed_tpu.serving.cache import (SCRATCH_BLOCK, BlockAllocator,
 TIMELINE_CAP = 1024
 
 
+class EngineDraining(RuntimeError):
+    """`submit()` after `drain()` began.
+
+    A draining replica finishes the work it already accepted and
+    admits nothing new — the typed rejection (instead of the old
+    implicit behavior: queued-forever under load shedding, silent
+    acceptance after a drain request) is what lets a fleet router
+    re-route the request instead of wedging it on a replica that is
+    about to deregister. `pending` carries the in-flight count so the
+    caller can size its retry-after."""
+
+    def __init__(self, pending: int):
+        super().__init__(
+            f"engine is draining ({pending} accepted request(s) still "
+            f"in flight); submit to another replica")
+        self.pending = int(pending)
+
+
 def table_width(n_blocks: int, base: int) -> int:
     """Geometric block-table width bucket (base, 2*base, 4*base, ...):
     the compile key for the gathered reads. Linear bucketing would
@@ -399,6 +417,12 @@ class ServingEngine:
         # --shed-load, so the alert plane is telemetry-only otherwise.
         self.admission_paused = False
         self._critical_slos: set[str] = set()
+        # graceful drain (round 15, fleet router): `drain()` flips this
+        # — accepted work (queued AND running) completes, new submits
+        # raise the typed EngineDraining. Distinct from the shed pause
+        # above: shedding holds the queue and resumes; draining empties
+        # the engine for deregistration/scale-down and never resumes.
+        self.draining = False
         self._admit_counter = 0
         self._win_tokens = 0            # tokens since the last log line
         self._win_t = clock()
@@ -416,16 +440,36 @@ class ServingEngine:
     # ------------------------------------------------------ public API
 
     def submit(self, prompt, max_new: int, temperature: float = 0.0,
-               seed: int = 0, rid: str | None = None) -> str:
+               seed: int = 0, rid: str | None = None,
+               generated=()) -> str:
         """Queue one request. Rejects (typed ValueError) requests that
         could never run: prompt + max_new past cfg.max_seq, or a block
         footprint larger than the whole pool (the no-deadlock
-        precondition — an admitted request can always finish alone)."""
+        precondition — an admitted request can always finish alone).
+        Raises the typed `EngineDraining` after `drain()` began.
+
+        `generated` resumes a half-decoded stream FROM ANOTHER ENGINE:
+        the tokens already emitted elsewhere re-prefill with the prompt
+        and sampling continues at token index len(generated) — exactly
+        the evict-newest continuation mechanism, now crossing a process
+        boundary. Because token i of a request always draws from
+        `fold_in(PRNGKey(seed), i)`, the continued stream is
+        token-identical to the solo `generate()` stream no matter which
+        engine emitted the prefix (the fleet router's seeded idempotent
+        re-dispatch rides this). `max_new` stays the TOTAL budget; the
+        result stream includes the resumed prefix."""
+        if self.draining:
+            raise EngineDraining(self.pending())
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         tp = prompt.shape[0]
+        generated = [int(t) for t in generated]
         if tp < 1 or max_new < 1:
             raise ValueError(f"empty request: prompt {tp} tokens, "
                              f"max_new={max_new}")
+        if len(generated) >= max_new:
+            raise ValueError(
+                f"continuation already carries {len(generated)} of "
+                f"max_new={max_new} tokens — nothing left to decode")
         if tp + max_new > self.cfg.max_seq:
             raise ValueError(f"prompt {tp} + max_new {max_new} exceeds "
                              f"max_seq={self.cfg.max_seq}")
@@ -444,9 +488,17 @@ class ServingEngine:
             raise ValueError(f"duplicate request id {rid!r}")
         req = _Req(rid, prompt, max_new, temperature, seed,
                    self.clock())
+        if generated:
+            # resume mid-stream: identical state to a post-eviction
+            # requeue — ctx re-prefills prompt + prefix, the next
+            # sample draws at token index len(generated)
+            req.generated = generated
+            req.ctx = np.concatenate(
+                [prompt, np.asarray(generated, np.int32)])
         self.queue.append(req)
         self.counters["submitted"] += 1
-        self._lifecycle(req, "submit", tokens=int(tp))
+        extra = {"resumed": len(generated)} if generated else {}
+        self._lifecycle(req, "submit", tokens=int(tp), **extra)
         self._lifecycle(req, "queued")
         return rid
 
@@ -499,6 +551,17 @@ class ServingEngine:
                     f"free_blocks={self.alloc.n_free})")
             steps += 1
         return dict(self.results)
+
+    def drain(self) -> bool:
+        """Graceful drain: stop admitting NEW submissions (they raise
+        the typed `EngineDraining`), let everything already accepted —
+        queued and running — run to completion. Idempotent; returns
+        True when all accepted work has finished, so a scale-down loop
+        is `while not eng.drain(): eng.step()` followed by
+        deregistration. Queue shedding (`on_alert`) pauses and resumes;
+        drain is one-way."""
+        self.draining = True
+        return self.pending() == 0
 
     def executable_counts(self) -> dict:
         """Live jit-cache sizes of the serving entrypoints — the

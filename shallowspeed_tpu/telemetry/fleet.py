@@ -67,6 +67,7 @@ any box that can reach the replicas.
 from __future__ import annotations
 
 import json
+import random
 import statistics
 import threading
 import time
@@ -87,10 +88,21 @@ STRAGGLER_Q = 50
 
 class Replica:
     """One fleet member: an endpoint to poll or a file to tail, plus
-    the latest observed state the collector aggregates."""
+    the latest observed state the collector aggregates.
+
+    Unreachable endpoints back off exponentially (seeded jitter, round
+    15) instead of re-GETting every refresh round: a dead replica used
+    to cost every round a full connect-timeout, which is exactly when
+    the fleet's own /status.json most needs the poll loop responsive.
+    The backoff state is visible in the per-replica breakdown
+    (`summary()["backoff"]`), downtime keeps feeding the availability
+    rule on skipped rounds, and a successful poll (or a
+    re-registration) resets the stream."""
 
     def __init__(self, name: str | None, url: str | None = None,
-                 path=None, timeout: float = 5.0):
+                 path=None, timeout: float = 5.0,
+                 poll_backoff: float = 1.0,
+                 poll_backoff_max: float = 30.0):
         assert (url is None) != (path is None), "exactly one source"
         self._label = name
         self.uid = -1            # stable collector-assigned index: the
@@ -100,6 +112,12 @@ class Replica:
             if url else None
         self.path = str(path) if path is not None else None
         self.timeout = float(timeout)
+        self.poll_backoff = float(poll_backoff)
+        self.poll_backoff_max = float(poll_backoff_max)
+        self.fail_streak = 0      # consecutive failed polls
+        self.backoff_s = 0.0      # current backoff window (jittered)
+        self.next_poll = 0.0      # wall before which refresh skips I/O
+        self._rng = random.Random(url or str(path))
         self.alive = False
         self.last_seen: float | None = None
         self.error: str | None = None
@@ -158,13 +176,24 @@ class Replica:
                 if n:
                     self.last_seen = now
             return self.alive
+        if self.fail_streak and now < self.next_poll:
+            # backing off: no I/O this round (the replica stays "down"
+            # and keeps burning availability; summary() shows why)
+            return False
         try:
             self._status = self._get("/status.json")
             payload = self._get("/sketches.json")
         except Exception as e:
             self.alive = False
             self.error = f"{type(e).__name__}: {e}"
+            self.fail_streak += 1
+            base = min(self.poll_backoff * 2 ** (self.fail_streak - 1),
+                       self.poll_backoff_max)
+            self.backoff_s = base * (1.0 + 0.25 * self._rng.random())
+            self.next_poll = now + self.backoff_s
             return False
+        self.fail_streak = 0
+        self.backoff_s = 0.0
         self._label = self._label or payload.get("label") \
             or self._status.get("replica")
         self._rel_err = float(payload.get("rel_err", 0.01))
@@ -212,6 +241,10 @@ class Replica:
         }
         if self.error:
             out["error"] = self.error
+        if self.fail_streak:
+            out["backoff"] = {"failures": self.fail_streak,
+                              "backoff_s": round(self.backoff_s, 3),
+                              "retry_at": round(self.next_poll, 3)}
         return out
 
 
@@ -306,7 +339,12 @@ class FleetCollector:
     def register_replica(self, payload: dict) -> dict:
         """POST /register body: {"url": status URL, "name": label}.
         Re-registration of a known URL refreshes its label instead of
-        duplicating the replica (a restarted replica re-announces)."""
+        duplicating the replica (a restarted replica re-announces);
+        re-registration of a known NAME at a new URL re-points that
+        replica (a respawned process binds a fresh port — its history,
+        straggler state and uid stay attached to the name). Either
+        way the poller's backoff resets: a replica announcing itself
+        is the strongest possible liveness signal."""
         url = payload.get("url")
         if not isinstance(url, str) or not url.startswith("http"):
             raise ValueError(f"register needs a status 'url', got "
@@ -315,11 +353,46 @@ class FleetCollector:
         with self._lock:
             base = url.rstrip("/").removesuffix("/status.json")
             for rep in self.replicas:
-                if rep.url == base:
+                if rep.url == base or (name and rep.url is not None
+                                       and rep._label == name):
                     rep._label = name or rep._label
+                    rep.url = base
+                    rep.fail_streak = 0
+                    rep.backoff_s = 0.0
+                    rep.next_poll = 0.0
                     return {"ok": True, "replicas": len(self.replicas)}
             self.add_url(url, name)
             return {"ok": True, "replicas": len(self.replicas)}
+
+    def deregister_replica(self, payload: dict) -> dict:
+        """POST /deregister body: {"url" and/or "name"} — removal on
+        clean drain. Registration used to be one-way: a drained
+        replica stayed in the fleet as "unreachable" and burned
+        availability forever. Removes the replica AND purges its
+        uid-keyed detector state (SLO deltas, straggler EWMAs) so a
+        later replica re-using the name starts clean. Unknown
+        replicas raise (the HTTP surface turns that into a 400)."""
+        url = payload.get("url")
+        name = payload.get("name")
+        base = url.rstrip("/").removesuffix("/status.json") \
+            if isinstance(url, str) else None
+        with self._lock:
+            for rep in self.replicas:
+                if (base is not None and rep.url == base) \
+                        or (name and rep.name == name):
+                    self.replicas.remove(rep)
+                    uid = rep.uid
+                    self._slo_prev = {k: v for k, v
+                                      in self._slo_prev.items()
+                                      if k[1] != uid}
+                    for d in (self._ewma, self._runs, self.stragglers):
+                        for key in [k for k in d if k[0] == uid]:
+                            del d[key]
+                    return {"ok": True, "replicas": len(self.replicas),
+                            "removed": rep.name}
+            raise ValueError(
+                f"deregister: no replica matches url={url!r} / "
+                f"name={name!r}")
 
     # --------------------------------------------------------- refresh
 

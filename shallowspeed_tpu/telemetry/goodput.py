@@ -328,6 +328,75 @@ def run_goodput(path) -> dict:
         # per-phase request time (where did request latency go:
         # queued vs prefill vs decoding vs preempted)
         "lifecycle": _lifecycle_block(recs),
+        # None without schema-v10 routing events — the fleet block: a
+        # ROUTER's log reduces to per-replica MTTR, fleet
+        # availability, failover/breaker/scale tallies (the router
+        # process itself never restarts, so the per-replica
+        # restart_downtime stamps — not stanza gaps — carry the
+        # fleet's downtime story)
+        "fleet": _fleet_block(recs, wall),
+    }
+
+
+def _fleet_block(recs, wall: float) -> dict | None:
+    """Reduce schema-v10 routing events + replica-stamped ledger lines
+    to the fleet report: routes/failovers/scale decisions, breaker
+    trips, per-replica MTTR (from the router's replica-labelled
+    restart_downtime stamps), per-replica and mean fleet availability.
+    A replica never seen down is fully available; the denominator is
+    the router log's wall span (the router observed the whole fleet
+    for that long)."""
+    routes = fails = 0
+    scale = {"up": 0, "drain": 0, "down": 0}
+    trips = 0
+    names: set[str] = set()
+    mttr: dict[str, dict] = {}
+    for rec in recs:
+        ev = rec.get("event")
+        if ev == "route":
+            routes += 1
+            names.add(str(rec.get("replica")))
+        elif ev == "failover":
+            fails += 1
+            names.add(str(rec.get("replica")))
+        elif ev == "scale":
+            action = str(rec.get("action"))
+            scale[action] = scale.get(action, 0) + 1
+            if isinstance(rec.get("replica"), str):
+                names.add(rec["replica"])
+        elif ev == "ledger" and isinstance(rec.get("replica"), str):
+            names.add(rec["replica"])
+            if rec.get("kind") == "breaker" \
+                    and rec.get("state") == "open":
+                trips += 1
+            if rec.get("kind") == "restart_downtime" \
+                    and isinstance(rec.get("seconds"), (int, float)):
+                m = mttr.setdefault(rec["replica"],
+                                    {"count": 0, "total_s": 0.0})
+                m["count"] += 1
+                m["total_s"] += float(rec["seconds"])
+    if not (routes or fails or any(scale.values())):
+        return None
+    names.discard("?")
+    for m in mttr.values():
+        m["total_s"] = round(m["total_s"], 3)
+        m["mttr_s"] = round(m["total_s"] / m["count"], 3)
+    avail = {}
+    for name in sorted(names):
+        down = mttr.get(name, {}).get("total_s", 0.0)
+        avail[name] = (round(1.0 - min(down, wall) / wall, 4)
+                       if wall > 0 else None)
+    vals = [a for a in avail.values() if a is not None]
+    return {
+        "replicas": sorted(names),
+        "routes": routes,
+        "failovers": fails,
+        "breaker_trips": trips,
+        "scale": {k: v for k, v in scale.items() if v},
+        "mttr": mttr,
+        "availability": avail,
+        "fleet_availability": (round(sum(vals) / len(vals), 4)
+                               if vals else None),
     }
 
 
@@ -466,6 +535,21 @@ def format_report(rep: dict) -> str:
         lines.append(
             f"lifecycle ({lc['complete']}/{lc['requests']} complete): "
             + "  ".join(f"{k} {v:.0f} ms" for k, v in top))
+    fl = rep.get("fleet")
+    if fl:
+        lines.append(
+            f"fleet [{', '.join(fl['replicas'])}]: "
+            f"{fl['routes']} route(s), {fl['failovers']} failover(s), "
+            f"{fl['breaker_trips']} breaker trip(s)"
+            + (f", scale {fl['scale']}" if fl["scale"] else ""))
+        for name, m in sorted(fl["mttr"].items()):
+            lines.append(
+                f"  mttr[{name:<8}] {m['count']} recover(ies), mean "
+                f"{m['mttr_s']} s   availability "
+                f"{fl['availability'].get(name)}")
+        if fl["fleet_availability"] is not None:
+            lines.append(
+                f"  fleet availability {fl['fleet_availability']:.2%}")
     mon = rep.get("monitor")
     if mon:
         qs = mon["quantiles"]

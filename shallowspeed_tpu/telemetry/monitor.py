@@ -716,15 +716,38 @@ class StatusServer:
     Duck-typed over `monitor`: anything with `status()`/`prometheus()`
     serves (a `fleet.FleetCollector` plugs in unchanged). Objects that
     also expose `sketch_payload()` get GET /sketches.json (the
-    serialized mergeable sketches a fleet poller needs), and objects
-    with `register_replica(payload)` get POST /register (a replica
-    announcing its own status URL to a fleet collector)."""
+    serialized mergeable sketches a fleet poller needs); objects with
+    `register_replica(payload)` / `deregister_replica(payload)` get
+    POST /register and /deregister (a replica announcing — or, on
+    clean drain, withdrawing — its status URL at a fleet collector);
+    objects with `submit_request` / `poll_requests` / `drain_request`
+    (a `serving.router.RequestGateway`) get POST /submit, GET
+    /requests and POST /drain — the replica-side request-ingestion
+    surface the fleet router drives. `extra` grafts a second target
+    behind the same port (serve.py serves its Monitor AND its gateway
+    on one endpoint); the first of (monitor, extra) providing a method
+    wins."""
+
+    # POST path -> duck-typed method on the served object(s)
+    _POSTS = {"/register": "register_replica",
+              "/deregister": "deregister_replica",
+              "/submit": "submit_request",
+              "/drain": "drain_request"}
 
     def __init__(self, monitor: Monitor, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", extra=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+        def find(name):
+            for obj in (monitor, extra):
+                if obj is not None and hasattr(obj, name):
+                    return getattr(obj, name)
+            return None
+
+        posts = {path: find(meth) for path, meth in self._POSTS.items()
+                 if find(meth) is not None}
         mon = monitor
+        poll_requests = find("poll_requests")
 
         class _Handler(BaseHTTPRequestHandler):
             def _send(self, body: bytes, ctype: str) -> None:
@@ -746,6 +769,11 @@ class StatusServer:
                         body = json.dumps(mon.sketch_payload(),
                                           default=str).encode()
                         ctype = "application/json"
+                    elif path == "/requests" \
+                            and poll_requests is not None:
+                        body = json.dumps(poll_requests(),
+                                          default=str).encode()
+                        ctype = "application/json"
                     elif path == "/metrics":
                         body = mon.prometheus().encode()
                         ctype = ("text/plain; version=0.0.4; "
@@ -759,14 +787,14 @@ class StatusServer:
                 self._send(body, ctype)
 
             def do_POST(self):
-                if self.path.split("?")[0] != "/register" \
-                        or not hasattr(mon, "register_replica"):
+                fn = posts.get(self.path.split("?")[0])
+                if fn is None:
                     self.send_error(404)
                     return
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    out = mon.register_replica(payload)
+                    out = fn(payload)
                 except Exception as e:
                     self.send_error(400, repr(e)[:120])
                     return
@@ -805,17 +833,18 @@ class StatusServer:
 # ------------------------------------------------- driver-side wiring
 
 
-def from_args(args, metrics, flight_dir=None):
+def from_args(args, metrics, flight_dir=None, extra=None):
     """One-call driver wiring: build the Monitor + StatusServer when
     any of --monitor-port / --slo / --flight-recorder is set, attach
     it to the MetricsLogger (every logged line flows into
     `note_line`), and return (monitor, server) — (None, None) when the
-    plane is off. The caller owns `close_monitor(monitor, server)` at
-    teardown."""
+    plane is off. `extra` (serve.py's request gateway) is grafted onto
+    the same endpoint (see StatusServer) and forces the plane on. The
+    caller owns `close_monitor(monitor, server)` at teardown."""
     port = getattr(args, "monitor_port", None)
     slo = getattr(args, "slo", "") or ""
     flight = int(getattr(args, "flight_recorder", 0) or 0)
-    if port is None and not slo and not flight:
+    if port is None and not slo and not flight and extra is None:
         return None, None
     if flight_dir is None:
         log_file = getattr(args, "log_file", "") or ""
@@ -825,7 +854,8 @@ def from_args(args, metrics, flight_dir=None):
                   label=getattr(args, "replica", None) or None)
     if metrics is not None:
         metrics.monitor = mon
-    server = StatusServer(mon, port=port) if port is not None else None
+    server = StatusServer(mon, port=port, extra=extra) \
+        if port is not None else None
     return mon, server
 
 

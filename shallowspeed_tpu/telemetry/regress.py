@@ -48,6 +48,11 @@ METRICS = {
     # the bench's templated prompts — a drop here means speculation
     # stopped paying, which is exactly what the gate should catch
     "serving_spec_tok_per_sec": (0.35, None),
+    # fleet router headline (round 15, bench.py's 2-replica in-process
+    # sweep): the serving dispatch noise PLUS the router's host-side
+    # polling/scoring — a drop here with serving_tok_per_sec flat
+    # means routing overhead grew; rounds before r15 pass vacuously
+    "fleet_tok_per_sec": (0.35, None),
 }
 
 

@@ -72,12 +72,24 @@ from pathlib import Path
 # `table_rebucket` stamp's width/prev_width/tick fields (a request's
 # block table crossing a geometric width bucket re-traces the decode
 # tick; the stamp keeps attribution from booking it as unexplained).
+# 10 = v9 plus the fleet-serving extension (round 15, the router —
+# `shallowspeed_tpu/serving/router.py` + `router.py`): "route" events
+# (one per dispatch: request id -> replica, with the admission score),
+# "failover" events (one per seeded idempotent re-dispatch after a
+# replica death / progress timeout: from/to replicas, reason, tokens
+# already emitted), "scale" events (autoscale decisions: action
+# up/drain/down, replica, reason, the burn that triggered), `replica`
+# + `state` fields on "ledger" lines (per-replica restart_downtime
+# stamps the fleet MTTR/availability reduction reads; circuit-breaker
+# open/half_open/closed transitions), `replica`/`failovers` on the
+# router's fleet-edge "request" records, and `resumed` on "lifecycle"
+# submit lines (a continuation re-prefilled from another engine).
 # The validator accepts ALL dialects — every versioned field is
-# optional, so committed v1-v8 artifacts (no version stamp / no
+# optional, so committed v1-v9 artifacts (no version stamp / no
 # health / overlap / attrib / wall / fault / request / monitor /
-# straggler / lifecycle / speculation fields) keep validating
-# unchanged.
-SCHEMA_VERSION = 9
+# straggler / lifecycle / speculation / routing fields) keep
+# validating unchanged.
+SCHEMA_VERSION = 10
 
 _NUM = (int, float)
 
@@ -123,13 +135,25 @@ _METRIC_EVENTS = {
     # (serving/engine.ServingEngine._lifecycle) — the per-request span
     # timeline `report.request_timeline` reconstructs
     "lifecycle": {"id": str, "phase": str},
+    # schema v10: one line per router dispatch decision — which
+    # replica got the request (serving/router.Router._dispatch)
+    "route": {"id": str, "replica": str},
+    # schema v10: one line per seeded idempotent re-dispatch — a
+    # request whose replica died (or stalled past the progress
+    # timeout) continuing, token-identically, elsewhere
+    "failover": {"id": str, "replica": str, "reason": str},
+    # schema v10: one line per autoscale decision (up / drain / down)
+    "scale": {"action": str},
 }
 
 # optional typed fields on a "ledger" line (`fail_class`: the
 # supervisor's failure classification riding its restart stamps;
-# width/prev_width/tick: the v9 `table_rebucket` retrace stamp)
+# width/prev_width/tick: the v9 `table_rebucket` retrace stamp;
+# replica/state: the v10 router stamps — per-replica restart downtime
+# and circuit-breaker transitions)
 _LEDGER_OPTIONAL = {"seconds": _NUM, "count": int, "fail_class": str,
-                    "width": int, "prev_width": int, "tick": int}
+                    "width": int, "prev_width": int, "tick": int,
+                    "replica": str, "state": str}
 
 # optional typed fields on a "fault" line
 _FAULT_OPTIONAL = {"step": int, "save": int, "seconds": _NUM,
@@ -142,7 +166,9 @@ _FAULT_OPTIONAL = {"step": int, "save": int, "seconds": _NUM,
 # average
 _REQUEST_OPTIONAL = {"tpot_ms": _NUM, "e2e_ms": _NUM, "wait_ms": _NUM,
                      "queue_depth": int, "preempted": int,
-                     "spec_drafted": int, "spec_accepted": int}
+                     "spec_drafted": int, "spec_accepted": int,
+                     # v10: the router's fleet-edge request records
+                     "replica": str, "failovers": int}
 
 # optional typed fields on a "generate" line (schema v9: the serving
 # tick fields written since v6 become typed, plus the speculation
@@ -164,7 +190,13 @@ _STRAGGLER_OPTIONAL = {"ratio": _NUM, "z": _NUM, "replica_q": _NUM,
                        "fleet_q": _NUM, "q": int, "rounds": int}
 _LIFECYCLE_OPTIONAL = {"seq": int, "slot": int, "tick": int,
                        "chunk": int, "tokens": int, "prev": str,
-                       "ms_in_prev": _NUM}
+                       "ms_in_prev": _NUM, "resumed": int}
+
+# optional typed fields on the schema-v10 routing events
+_ROUTE_OPTIONAL = {"queue_depth": int, "score": _NUM}
+_FAILOVER_OPTIONAL = {"from": str, "tokens_done": int, "attempt": int}
+_SCALE_OPTIONAL = {"replica": str, "reason": str, "n_replicas": int,
+                   "burn": _NUM}
 
 # telemetry fields a step line MAY carry; when present they must type
 _STEP_TELEMETRY = {
@@ -253,10 +285,14 @@ def _validate_metric(rec: dict) -> list[str]:
                                  or isinstance(rec[field], bool)):
                 probs.append(f"generate: field {field!r} is "
                              f"{type(rec[field]).__name__}")
-    if ev in ("monitor", "alert", "straggler", "lifecycle"):
+    if ev in ("monitor", "alert", "straggler", "lifecycle", "route",
+              "failover", "scale"):
         opt = {"monitor": _MONITOR_OPTIONAL, "alert": _ALERT_OPTIONAL,
                "straggler": _STRAGGLER_OPTIONAL,
-               "lifecycle": _LIFECYCLE_OPTIONAL}[ev]
+               "lifecycle": _LIFECYCLE_OPTIONAL,
+               "route": _ROUTE_OPTIONAL,
+               "failover": _FAILOVER_OPTIONAL,
+               "scale": _SCALE_OPTIONAL}[ev]
         for field, typ in opt.items():
             if field in rec and (not isinstance(rec[field], typ)
                                  or isinstance(rec[field], bool)):

@@ -17,6 +17,7 @@ Acceptance pins:
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -505,6 +506,129 @@ def test_stall_chaos_on_one_replica_names_straggler(tmp_path,
     assert sum(tl["by_phase_ms"].values()) == pytest.approx(
         tl["e2e_ms"], abs=2.0)
     assert max(tl["by_phase_ms"].values()) >= 900.0
+
+
+# --------------------------- deregistration + poller backoff (round 15)
+
+
+def test_fleet_deregistration_removes_replica_and_state(tmp_path):
+    """POST /deregister (the missing half of /register): the drained
+    replica leaves the fleet view entirely — no eternal "unreachable"
+    availability burn — and its uid-keyed straggler/SLO state is
+    purged so a later replica reusing the name starts clean."""
+    reps = {"a": [20.0] * 10, "b": [22.0] * 10, "c": [130.0] * 10}
+    fc = FleetCollector(
+        paths=[_write_replica_jsonl(tmp_path / f"{r}.jsonl", r, v)
+               for r, v in reps.items()],
+        slos="ttft_p50_ms<100",
+        slo_kw=dict(fast_s=10, slow_s=60, min_count=5),
+        straggler_metrics=("ttft_ms",), straggler_patience=1,
+        straggler_min_count=4)
+    fc.refresh()
+    assert fc.stragglers                 # c diverges
+    uid_c = next(rep.uid for rep in fc.replicas if rep.name == "c")
+    out = fc.deregister_replica({"name": "c"})
+    assert out == {"ok": True, "replicas": 2, "removed": "c"}
+    assert not fc.stragglers             # uid-keyed state purged
+    assert not any(k[0] == uid_c for k in fc._ewma)
+    assert not any(k[1] == uid_c for k in fc._slo_prev)
+    st = fc.refresh()
+    assert set(st["replicas"]) == {"a", "b"}
+    with pytest.raises(ValueError, match="no replica"):
+        fc.deregister_replica({"name": "ghost"})
+
+
+def test_fleet_deregister_over_http(tmp_path):
+    mon = Monitor(label="x", flight=0)
+    for i in range(4):
+        mon.note_line({"event": "request", "id": f"x{i}",
+                       "ttft_ms": 10.0, "tokens_in": 1,
+                       "tokens_out": 1, "wall": 50.0 + i})
+    srv_x = StatusServer(mon, port=0)
+    fc = FleetCollector(urls=[srv_x.url("/status.json")],
+                        labels=["x"])
+    fleet_srv = StatusServer(fc, port=0)
+    try:
+        body = json.dumps({"url": srv_x.url("/status.json")}).encode()
+        resp = json.loads(urllib.request.urlopen(
+            urllib.request.Request(
+                fleet_srv.url("/deregister"), data=body,
+                headers={"Content-Type": "application/json"}),
+            timeout=10).read())
+        assert resp["ok"] and resp["replicas"] == 0
+        # deregistering the unknown again is a 400, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                fleet_srv.url("/deregister"), data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=10)
+        assert ei.value.code == 400
+    finally:
+        fleet_srv.close()
+        srv_x.close()
+
+
+def test_fleet_register_by_name_repoints_respawned_replica():
+    """A respawned replica binds a fresh port and re-announces under
+    its NAME: registration re-points the existing replica's URL (uid,
+    history, straggler state stay attached) instead of duplicating,
+    and resets the poller's backoff."""
+    fc = FleetCollector(urls=["http://127.0.0.1:9"], labels=["r0"])
+    rep = fc.replicas[0]
+    rep.fail_streak, rep.next_poll = 3, 1e18      # deep in backoff
+    out = fc.register_replica({"url": "http://127.0.0.1:10101",
+                               "name": "r0"})
+    assert out["replicas"] == 1                   # no duplicate
+    assert rep.url == "http://127.0.0.1:10101"
+    assert rep.fail_streak == 0 and rep.next_poll == 0.0
+
+
+def test_fleet_poller_backoff_on_unreachable(monkeypatch):
+    """An unreachable endpoint backs off exponentially (with jitter)
+    instead of hot re-polling every round: attempts 1, 2 fire, then
+    refreshes inside the backoff window cost NO I/O; the window
+    doubles per failure (capped), the per-replica breakdown names the
+    state, and downtime keeps burning availability on skipped
+    rounds."""
+    clock = [100.0]
+    fc = FleetCollector(urls=["http://127.0.0.1:9"],
+                        slos="availability>0.9",
+                        clock=lambda: clock[0], timeout=0.1,
+                        slo_kw=dict(fast_s=10, slow_s=100))
+    rep = fc.replicas[0]
+    attempts = []
+
+    def failing_get(endpoint):
+        attempts.append(clock[0])
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(rep, "_get", failing_get)
+    fc.refresh()
+    assert len(attempts) == 1 and rep.fail_streak == 1
+    b1 = rep.backoff_s
+    assert 1.0 <= b1 <= 1.25          # base 1s, jitter <= 25%
+    summary = rep.summary()
+    assert summary["backoff"]["failures"] == 1
+    assert summary["backoff"]["retry_at"] == pytest.approx(
+        100.0 + b1, abs=1e-3)      # summary rounds to ms
+    clock[0] += b1 / 2
+    fc.refresh()                       # inside the window: skipped
+    assert len(attempts) == 1
+    clock[0] += b1                     # past it: retried, doubles
+    fc.refresh()
+    assert len(attempts) == 2 and rep.fail_streak == 2
+    assert 2.0 <= rep.backoff_s <= 2.5
+    # skipped rounds still burn the availability rule
+    assert fc.rules[0].burn(10, clock[0]) > 0
+    # success resets the stream (swap in a working _get)
+    monkeypatch.setattr(
+        rep, "_get",
+        lambda ep: {"sketches": {}, "rel_err": 0.01}
+        if ep == "/sketches.json" else {})
+    clock[0] += rep.backoff_s + 0.01
+    fc.refresh()
+    assert rep.fail_streak == 0 and rep.backoff_s == 0.0
+    assert "backoff" not in rep.summary()
 
 
 # ----------------------------------------------- gang supervisor wiring

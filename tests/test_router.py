@@ -1,0 +1,660 @@
+"""Fault-tolerant fleet serving (round 15): the SLO-aware router —
+`shallowspeed_tpu/serving/router.py` + the `router.py` driver.
+
+The load-bearing invariants:
+
+- **Failover stream parity.** A request whose replica dies mid-decode
+  re-dispatches (seeded, idempotent: prompt + tokens-so-far re-prefill
+  on another replica) and its completed stream is TOKEN-IDENTICAL to
+  the solo `generate()` oracle — the engine's evict-newest
+  continuation crossing a process boundary. The in-process canary here
+  is default-tier; the cross-process fleet chaos drill (real serve.py
+  subprocesses, SIGKILL mid-decode + stall + heartbeat freeze) rides
+  the slow tier.
+- **Circuit breakers.** Consecutive-failure trip, jittered doubling
+  cooldown, half-open single-probe recovery; replica death force-opens;
+  transitions stamped as schema-v10 ledger lines.
+- **Fleet-edge backpressure.** Typed `FleetOverloaded` + retry-after
+  when every breaker is open or the queue exceeds budget — never
+  silent queue growth.
+- **Burn-driven autoscaling.** Sustained critical ttft burn (the
+  Monitor's dual-window rule over the router's own observations)
+  spawns a replica; sustained idle drains one gracefully with
+  deregistration and zero dropped requests.
+- **Schema v10 + goodput.** route/failover/scale events validate; the
+  goodput reducer's fleet block reports per-replica MTTR and fleet
+  availability from a router log alone.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from shallowspeed_tpu.serving.router import (CircuitBreaker,
+                                             FleetOverloaded,
+                                             InProcessReplica,
+                                             RequestGateway, Router)
+from shallowspeed_tpu.telemetry.schema import (SCHEMA_VERSION,
+                                               validate_file,
+                                               validate_line)
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def serving_fixture():
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                              n_layers=2, max_seq=128)
+    params = jax.device_put(T.init(cfg, seed=1))
+    return params, cfg
+
+
+def toks(seed=0, t=12, vocab=64):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, (t,)).astype(np.int32)
+
+
+def solo(params, cfg, prompt, max_new, **kw):
+    from shallowspeed_tpu.models.generate import generate
+
+    return np.asarray(generate(params, prompt[None, :], cfg, max_new,
+                               **kw))[0]
+
+
+def make_spawn(params, cfg, clock=None, **engine_kw):
+    from shallowspeed_tpu.serving import ServingEngine
+
+    kw = dict(n_blocks=32, block_size=8, max_slots=4,
+              prefill_chunk=16)
+    kw.update(engine_kw)
+
+    def factory(name):
+        return ServingEngine(params, cfg, **kw)
+
+    def spawn(name):
+        return (InProcessReplica(name, factory)
+                if clock is None
+                else InProcessReplica(name, factory, clock=clock))
+
+    return spawn
+
+
+# ------------------------------------------------------ circuit breaker
+
+
+def test_circuit_breaker_trip_halfopen_recover():
+    clock = [100.0]
+    transitions = []
+    br = CircuitBreaker(threshold=3, cooldown=2.0, cooldown_max=10.0,
+                        jitter=0.5, seed=7,
+                        on_transition=lambda st, t: transitions.append(
+                            (st, t)))
+    now = lambda: clock[0]  # noqa: E731
+    assert br.allow(now()) and br.state == "closed"
+    br.note_failure(now())
+    br.note_failure(now())
+    assert br.state == "closed"          # below threshold
+    br.note_success(now())
+    br.note_failure(now())
+    br.note_failure(now())
+    assert br.state == "closed"          # success reset the streak
+    br.note_failure(now())
+    br.note_failure(now())               # 3 consecutive -> trip
+    assert br.state == "open" and br.trips == 1
+    # jittered cooldown: within [cooldown, cooldown*(1+jitter)]
+    reopen = br.retry_after(now())
+    assert 2.0 <= reopen <= 3.0
+    assert not br.allow(now())           # still open
+    clock[0] += reopen + 0.01
+    assert br.allow(now())               # -> half-open, one probe
+    assert br.state == "half_open"
+    assert not br.allow(now())           # second probe denied
+    br.note_failure(now())               # probe failed -> reopen
+    assert br.state == "open"
+    # cooldown doubled (2.0 -> 4.0 base, still jitter-bounded)
+    assert 4.0 <= br.retry_after(now()) <= 6.0
+    clock[0] += br.retry_after(now()) + 0.01
+    assert br.allow(now())
+    br.note_success(now())               # probe succeeded -> closed
+    assert br.state == "closed"
+    # cooldown reset: a fresh trip starts from the base again
+    for _ in range(3):
+        br.note_failure(now())
+    assert 2.0 <= br.retry_after(now()) <= 3.0
+    assert [s for s, _ in transitions] == [
+        "open", "half_open", "open", "half_open", "closed", "open"]
+
+
+def test_circuit_breaker_force_open_on_death():
+    br = CircuitBreaker(threshold=5, cooldown=1.0, jitter=0.0)
+    br.force_open(10.0)
+    assert br.state == "open" and br.trips == 1
+    assert not br.allow(10.5)
+    assert br.allow(11.01) and br.state == "half_open"
+
+
+# ------------------------------------------------------ request gateway
+
+
+def test_gateway_submit_poll_drain_typed_rejections(serving_fixture):
+    from shallowspeed_tpu.serving import ServingEngine
+
+    params, cfg = serving_fixture
+    eng = ServingEngine(params, cfg, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    gw = RequestGateway(max_queue=2)
+    ok = gw.submit_request({"id": "a",
+                            "prompt": [int(t) for t in toks(0, t=8)],
+                            "max_new": 4})
+    assert ok == {"ok": True, "id": "a"}
+    # duplicate rejected before it ever reaches the engine
+    assert "duplicate" in gw.submit_request(
+        {"id": "a", "prompt": [1], "max_new": 2})["error"]
+    gw.submit_request({"id": "b", "prompt": [1, 2], "max_new": 2})
+    over = gw.submit_request({"id": "c", "prompt": [1], "max_new": 2})
+    assert over["error"] == "EngineOverloaded"
+    assert over["retry_after"] > 0
+    assert gw.pump(eng) == 2
+    while eng.pending():
+        eng.step()
+        gw.publish(eng)
+    snap = gw.poll_requests()["requests"]
+    assert snap["a"]["status"] == "done"
+    assert len(snap["a"]["tokens"]) == 4
+    # drain: typed rejection at the gateway edge, no engine involved
+    assert gw.drain_request()["draining"] is True
+    rej = gw.submit_request({"id": "d", "prompt": [1], "max_new": 2})
+    assert rej["error"] == "EngineDraining" and rej["retry_after"] > 0
+    assert gw.idle()
+    # a malformed request publishes as rejected instead of crashing
+    gw2 = RequestGateway()
+    gw2.submit_request({"id": "bad", "prompt": [999999],
+                        "max_new": -1})
+    gw2.pump(eng)
+    assert gw2.poll_requests()["requests"]["bad"]["status"] \
+        == "rejected"
+
+
+# ------------------------------------------------- dispatch + balance
+
+
+def test_router_routes_to_least_loaded(serving_fixture):
+    params, cfg = serving_fixture
+    router = Router(make_spawn(params, cfg), n_replicas=2,
+                    request_timeout=None)
+    # 4 requests dispatched in one step: the score (router in-flight
+    # + replica queue pressure) must spread them over BOTH replicas
+    # rather than pile onto the first name
+    for i in range(4):
+        router.submit(toks(i, t=8), 4, rid=f"pre{i}")
+    router.step()
+    by_replica = {}
+    for r in router.inflight.values():
+        by_replica.setdefault(r.replica, []).append(r.rid)
+    assert set(by_replica) == {"r0", "r1"}, by_replica
+    assert {len(v) for v in by_replica.values()} == {2}, by_replica
+    router.run(max_wall=120)
+    assert len(router.results) == 4
+    routes = [e for e in router.events if e["event"] == "route"]
+    assert {e["replica"] for e in routes} == {"r0", "r1"}
+    for e in routes:
+        assert validate_line(e) == []
+
+
+def test_router_backpressure_typed_reject(serving_fixture):
+    params, cfg = serving_fixture
+    router = Router(make_spawn(params, cfg), n_replicas=1,
+                    queue_budget=2, request_timeout=None)
+    router.submit(toks(0, t=8), 4, rid="a")
+    router.submit(toks(1, t=8), 4, rid="b")
+    with pytest.raises(FleetOverloaded) as ei:
+        router.submit(toks(2, t=8), 4, rid="c")
+    assert ei.value.retry_after > 0
+    assert router.counters["rejected"] == 1
+    # every replica down -> the other reject shape, with the breaker /
+    # respawn wait as the retry hint
+    router.run(max_wall=120)
+    router._replicas["r0"]["handle"].kill()
+    router.step()
+    with pytest.raises(FleetOverloaded):
+        router.submit(toks(3, t=8), 4, rid="d")
+    with pytest.raises(ValueError, match="duplicate"):
+        router.submit(toks(4, t=8), 4, rid="a")
+
+
+# ----------------------------------- THE canary: failover mid-decode
+
+
+def test_router_failover_midstream_token_identical(serving_fixture,
+                                                   tmp_path):
+    """The in-process half of the pinned fleet chaos drill: kill a
+    replica while requests are mid-decode on it — every stream still
+    completes token-identical to its solo oracle (seeded idempotent
+    re-dispatch re-prefills prompt + prefix elsewhere), a failover
+    event is recorded per re-dispatched request, the breaker trips
+    and recovers, and the restart_downtime stamp carries replica +
+    fail_class. Greedy AND sampled requests (the key-schedule proof)."""
+    import time
+
+    from shallowspeed_tpu.metrics import MetricsLogger
+
+    params, cfg = serving_fixture
+    log = tmp_path / "router.jsonl"
+    router = Router(make_spawn(params, cfg), n_replicas=2,
+                    metrics=MetricsLogger(log, kind="router"),
+                    request_timeout=None,
+                    breaker_kw=dict(cooldown=0.05, jitter=0.0),
+                    policy_kw=dict(backoff=0.01, jitter=0.0))
+    reqs = {"g": (toks(20, t=10), 8, 0.0, 0),
+            "s": (toks(21, t=13), 8, 1.0, 7),
+            "t": (toks(22, t=9), 8, 0.7, 3)}
+    oracle = {k: solo(params, cfg, p, mn, temperature=tmp, seed=s)
+              for k, (p, mn, tmp, s) in reqs.items()}
+    for k, (p, mn, tmp, s) in reqs.items():
+        router.submit(p, mn, temperature=tmp, seed=s, rid=k)
+    # step until at least one request is mid-stream on r0
+    for _ in range(500):
+        router.step()
+        if any(r.replica == "r0" and 1 <= len(r.tokens) < r.max_new
+               for r in router.inflight.values()):
+            break
+    assert any(r.replica == "r0" for r in router.inflight.values())
+    router._replicas["r0"]["handle"].kill()          # SIGKILL analog
+    res = router.run(max_wall=120)
+    for k, ref in oracle.items():
+        np.testing.assert_array_equal(res[k], ref, err_msg=k)
+    assert router.counters["failovers"] >= 1
+    fos = [e for e in router.events if e["event"] == "failover"]
+    assert fos and all(validate_line(e) == [] for e in fos)
+    assert all(e["from"] == "r0" and e["replica"] != "r0"
+               for e in fos)
+    # respawn + breaker recovery (the probe is the progress poll)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 10.0:
+        router.step()
+        if router.counters["respawns"] >= 1 \
+                and router._breakers["r0"].state == "closed":
+            break
+        time.sleep(0.01)
+    assert router.counters["respawns"] == 1
+    assert router._breakers["r0"].state == "closed"
+    led = [e for e in router.events if e["event"] == "ledger"]
+    states = [e["state"] for e in led if e.get("kind") == "breaker"]
+    assert states == ["open", "half_open", "closed"]
+    rd = [e for e in led if e.get("kind") == "restart_downtime"]
+    assert rd and rd[0]["replica"] == "r0" \
+        and rd[0]["fail_class"] == "crash" and rd[0]["seconds"] > 0
+    # the recovered replica serves again
+    router.submit(toks(23, t=8), 4, rid="post")
+    router.run(max_wall=120)
+    assert "post" in router.results
+    # the router log validates as schema v10 end to end
+    assert SCHEMA_VERSION >= 10
+    assert validate_file(log) == []
+
+
+def test_router_timeout_failover_reattaches_when_alone(
+        serving_fixture):
+    """A progress-timeout failover with nowhere else to go (single
+    replica, still alive) re-attaches to the original replica instead
+    of re-submitting a duplicate id — the work is still running
+    there."""
+    params, cfg = serving_fixture
+    clock = [0.0]
+    router = Router(make_spawn(params, cfg), n_replicas=1,
+                    clock=lambda: clock[0], request_timeout=5.0,
+                    breaker_kw=dict(threshold=99))
+    router.submit(toks(30, t=8), 6, rid="x")
+    router.step()
+    assert router.inflight["x"].replica == "r0"
+    clock[0] += 10.0                       # no progress for 10 "s"
+    router.step()
+    assert router.inflight["x"].replica == "r0"    # re-attached
+    assert router.counters["failovers"] == 0
+    res = router.run(max_wall=120)
+    np.testing.assert_array_equal(
+        res["x"], solo(params, cfg, toks(30, t=8), 6,
+                       temperature=0.0))
+
+
+def test_router_deadline_exceeded_is_typed(serving_fixture):
+    params, cfg = serving_fixture
+    clock = [0.0]
+    router = Router(make_spawn(params, cfg), n_replicas=1,
+                    clock=lambda: clock[0], request_timeout=None)
+    router.submit(toks(31, t=8), 6, rid="dl", deadline_s=2.0)
+    clock[0] += 5.0
+    router.step()
+    assert "dl" not in router.results
+    rec = next(r for r in router.records if r["id"] == "dl")
+    assert rec["status"] == "deadline_exceeded"
+    assert router.counters["failed"] == 1
+    assert router.unfinished() == 0
+
+
+# --------------------------------------------- autoscale (end to end)
+
+
+def test_router_autoscale_burn_up_then_idle_drain(serving_fixture,
+                                                  tmp_path):
+    """Acceptance: a sustained ttft burn (every completion violates a
+    deliberately-impossible 1 ms SLO under a fake clock) fires the
+    dual-window critical alert, the router spawns a replica, the burn
+    clears (alert resolves); then sustained idle drains one replica
+    via graceful drain + collector deregistration — with zero dropped
+    requests."""
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.telemetry.fleet import FleetCollector
+
+    params, cfg = serving_fixture
+    clock = [1000.0]
+    collector = FleetCollector()
+    log = tmp_path / "scale.jsonl"
+    base_spawn = make_spawn(params, cfg, clock=lambda: clock[0],
+                            max_slots=2)
+
+    def spawn(name):
+        p = tmp_path / f"{name}.jsonl"
+        p.write_text("")
+        collector.add_file(p, label=name)
+        return base_spawn(name)
+
+    router = Router(spawn, n_replicas=1, collector=collector,
+                    metrics=MetricsLogger(log, kind="router"),
+                    clock=lambda: clock[0],
+                    slos="ttft_p95_ms<1",
+                    slo_kw=dict(fast_s=5, slow_s=20, min_count=3),
+                    request_timeout=None, autoscale=True,
+                    min_replicas=1, max_replicas=2,
+                    scale_hold_s=1.0, idle_drain_s=2.0,
+                    scale_cooldown_s=0.5)
+    for i in range(6):
+        router.submit(toks(40 + i, t=8), 4, rid=f"x{i}")
+    for _ in range(600):
+        clock[0] += 0.1
+        router.step()
+        if router.counters["scale_ups"] and not router.unfinished():
+            break
+    assert router.counters["scale_ups"] == 1
+    assert router.replica_names() == ["r0", "r1"]
+    assert len(router.results) == 6            # zero dropped
+    alerts = [e for e in router.events if e["event"] == "alert"]
+    assert alerts[0]["state"] == "firing" \
+        and alerts[0]["severity"] == "critical"
+    # idle: the burn ages out (alert resolves) and a replica drains
+    for _ in range(400):
+        clock[0] += 0.1
+        router.step()
+        if router.counters["scale_downs"]:
+            break
+    assert router.counters["scale_downs"] == 1
+    assert router.replica_names() == ["r0"]
+    assert all(r.state is None for r in router.rules)   # burn cleared
+    alerts = [e for e in router.events if e["event"] == "alert"]
+    assert [e["state"] for e in alerts
+            if e["slo"] == "ttft_p95_ms<1"][-1] == "resolved"
+    # deregistration: the drained replica left the collector
+    assert [rep.name for rep in collector.replicas] == ["r0"]
+    scale = [e for e in router.events if e["event"] == "scale"]
+    assert [e["action"] for e in scale] == ["up", "drain", "down"]
+    assert all(validate_line(e) == [] for e in scale)
+    assert scale[0]["reason"] == "burn" and scale[0]["burn"] > 1
+    assert validate_file(log) == []
+
+
+# ------------------------------------------------- schema + goodput
+
+
+def test_schema_v10_route_failover_scale_validation():
+    assert SCHEMA_VERSION >= 10
+    good = [
+        {"event": "route", "id": "a", "replica": "r0",
+         "queue_depth": 2, "score": 1.5},
+        {"event": "failover", "id": "a", "replica": "r1",
+         "reason": "death", "from": "r0", "tokens_done": 3,
+         "attempt": 1},
+        {"event": "scale", "action": "up", "replica": "r2",
+         "reason": "burn", "burn": 12.0, "n_replicas": 3},
+        {"event": "ledger", "kind": "breaker", "replica": "r0",
+         "state": "open"},
+        {"event": "ledger", "kind": "restart_downtime",
+         "seconds": 0.5, "fail_class": "hang", "replica": "r0"},
+        {"event": "request", "id": "a", "ttft_ms": 5.0,
+         "tokens_in": 4, "tokens_out": 8, "replica": "r1",
+         "failovers": 1},
+        {"event": "lifecycle", "id": "a", "phase": "submit",
+         "resumed": 3},
+    ]
+    for rec in good:
+        assert validate_line(rec) == [], rec
+    bad = [
+        {"event": "route", "id": "a"},                 # no replica
+        {"event": "failover", "id": "a", "replica": "r1"},  # no reason
+        {"event": "scale"},                            # no action
+        {"event": "route", "id": "a", "replica": "r0",
+         "score": "high"},
+        {"event": "ledger", "kind": "breaker", "replica": 3},
+        {"event": "request", "id": "a", "ttft_ms": 1.0,
+         "tokens_in": 1, "tokens_out": 1, "failovers": "two"},
+    ]
+    for rec in bad:
+        assert validate_line(rec) != [], rec
+
+
+def test_goodput_fleet_block_per_replica_mttr(tmp_path):
+    """A synthetic router log reduces to the fleet block: per-replica
+    MTTR from replica-stamped restart_downtime lines, breaker trips,
+    failover/scale tallies, and fleet availability — and the
+    formatted report prints them."""
+    from shallowspeed_tpu.telemetry.goodput import (format_report,
+                                                    run_goodput)
+
+    log = tmp_path / "router.jsonl"
+    wall0 = 1000.0
+    lines = [{"event": "run_start", "schema_version": 10,
+              "kind": "router", "wall": wall0, "t": 0.0}]
+    for i, rep in enumerate(["r0", "r1", "r0"]):
+        lines.append({"event": "route", "id": f"q{i}", "replica": rep,
+                      "wall": wall0 + 1 + i, "t": 1.0 + i})
+    lines += [
+        {"event": "ledger", "kind": "breaker", "replica": "r0",
+         "state": "open", "wall": wall0 + 5, "t": 5.0},
+        {"event": "failover", "id": "q0", "replica": "r1",
+         "reason": "death", "from": "r0", "tokens_done": 2,
+         "wall": wall0 + 5.1, "t": 5.1},
+        {"event": "ledger", "kind": "restart_downtime", "seconds": 2.0,
+         "fail_class": "crash", "replica": "r0", "wall": wall0 + 7,
+         "t": 7.0},
+        {"event": "ledger", "kind": "restart_downtime", "seconds": 1.0,
+         "fail_class": "hang", "replica": "r0", "wall": wall0 + 9,
+         "t": 9.0},
+        {"event": "scale", "action": "up", "replica": "r2",
+         "reason": "burn", "wall": wall0 + 10, "t": 10.0},
+        {"event": "request", "id": "q0", "ttft_ms": 50.0,
+         "tokens_in": 4, "tokens_out": 8, "replica": "r1",
+         "failovers": 1, "wall": wall0 + 20, "t": 20.0},
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    assert validate_file(log) == []
+    rep = run_goodput(log)
+    fl = rep["fleet"]
+    assert fl["routes"] == 3 and fl["failovers"] == 1
+    assert fl["breaker_trips"] == 1
+    assert fl["scale"] == {"up": 1}
+    assert set(fl["replicas"]) == {"r0", "r1", "r2"}
+    assert fl["mttr"]["r0"]["count"] == 2
+    assert fl["mttr"]["r0"]["mttr_s"] == pytest.approx(1.5)
+    # wall span = 20s; r0 down 3s of it -> 0.85; others 1.0
+    assert fl["availability"]["r0"] == pytest.approx(0.85)
+    assert fl["availability"]["r1"] == 1.0
+    assert fl["fleet_availability"] == pytest.approx((0.85 + 2) / 3)
+    # per-class MTTR (the training-era block) still reduces alongside
+    assert rep["mttr"]["crash"]["count"] == 1
+    out = format_report(rep)
+    assert "fleet [r0, r1, r2]" in out and "mttr[r0" in out
+    assert "fleet availability" in out
+    # a training log (no routing events) has no fleet block
+    assert run_goodput(ROOT / "docs_runs"
+                       / "chaos_r06_metrics.jsonl")["fleet"] is None
+
+
+# ------------------------------- cross-process fleet chaos drill (slow)
+
+
+def _oracle_params_cfg():
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                              n_layers=2, max_seq=128)
+    return jax.device_put(T.init(cfg, seed=0)), cfg
+
+
+def test_fleet_chaos_drill_cross_process(tmp_path):
+    """THE pinned drill (slow tier): a real router over three
+    `serve.py --serve` subprocess replicas under a seeded fleet chaos
+    plan — r0 SIGKILLed mid-decode (kill@3 on its engine ticks), r1
+    stalled (stall@2:0.75), r2's heartbeat frozen (freeze@1, so the
+    router's hang detector kills it). Every submitted request still
+    completes with a stream token-identical to its solo oracle, ≥1
+    failover event and ≥1 breaker trip/recover cycle are recorded,
+    and `--goodput` over the router log reports per-replica MTTR with
+    fleet availability."""
+    import sys
+
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.serving.router import ReplicaProc
+    from shallowspeed_tpu.telemetry.fleet import FleetCollector
+    from shallowspeed_tpu.telemetry.goodput import (format_report,
+                                                    run_goodput)
+    from shallowspeed_tpu.telemetry.monitor import StatusServer
+
+    params, cfg = _oracle_params_cfg()
+    chaos_map = {"r0": "kill@3", "r1": "stall@2:0.75",
+                 "r2": "freeze@1"}
+    collector = FleetCollector()
+    srv = StatusServer(collector, port=0)
+    fleet_url = f"http://{srv.host}:{srv.port}"
+    serve_py = str(ROOT / "serve.py")
+
+    def spawn(name):
+        hb = str(tmp_path / f"hb_{name}")
+        argv = [sys.executable, serve_py, "--serve",
+                "--monitor-port", "0", "--fleet-register", fleet_url,
+                "--replica", name, "--platform", "cpu",
+                "--log-file", str(tmp_path / f"rep_{name}.jsonl"),
+                "--heartbeat-file", hb,
+                "--vocab", "64", "--d-model", "32", "--n-heads", "4",
+                "--n-layers", "2", "--max-seq", "128",
+                "--n-blocks", "32", "--block-size", "8",
+                "--slots", "4", "--prefill-chunk", "16",
+                "--chaos", chaos_map[name],
+                "--chaos-state", str(tmp_path / f"chaos_{name}"),
+                "--chaos-seed", "0"]
+        # hang_timeout must clear the engine's worst compile pause (a
+        # fresh replica's first tick blocks the serve loop for seconds
+        # on a loaded CPU host) — 20 s kills only a genuinely frozen
+        # heartbeat, which is exactly r2's chaos fault
+        return ReplicaProc(
+            name, argv, collector, heartbeat_file=hb,
+            hang_timeout=20.0, term_grace=3.0,
+            stdout_path=str(tmp_path / f"rep_{name}.out"))
+
+    log = tmp_path / "router.jsonl"
+    router = Router(spawn, n_replicas=3, collector=collector,
+                    metrics=MetricsLogger(log, kind="router"),
+                    request_timeout=45.0, progress_interval=0.1,
+                    breaker_kw=dict(cooldown=0.5, jitter=0.2),
+                    policy_kw=dict(backoff=0.2, jitter=0.1))
+    collector.start(poll=0.3)
+    try:
+        # wait for EVERY replica to register before offering load, so
+        # dispatch spreads 2/2/2 and each replica's engine ticks reach
+        # its scheduled fault — the drill must be deterministic, not a
+        # race on whose jax import wins
+        import time
+
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 120.0:
+            router.step()
+            if not any(e["warming"]
+                       for e in router._replicas.values()):
+                break
+            time.sleep(0.1)
+        assert not any(e["warming"] for e in router._replicas.values())
+        reqs = {f"q{i}": (toks(60 + i, t=8 + 2 * (i % 3)), 6,
+                          0.7 if i % 2 else 0.0, i)
+                for i in range(6)}
+        oracle = {k: solo(params, cfg, p, mn, temperature=tmp, seed=s)
+                  for k, (p, mn, tmp, s) in reqs.items()}
+        for k, (p, mn, tmp, s) in reqs.items():
+            router.submit(p, mn, temperature=tmp, seed=s, rid=k)
+        res = router.run(max_wall=300.0, poll=0.05)
+        for k, ref in oracle.items():
+            np.testing.assert_array_equal(res[k], ref, err_msg=k)
+        assert router.counters["failovers"] >= 1
+        fos = [e for e in router.events if e["event"] == "failover"]
+        assert fos and all(validate_line(e) == [] for e in fos)
+        # the kill fault actually fired on r0 (forensic stamp in its
+        # metrics JSONL), and the stall on r1
+        r0recs = [json.loads(line) for line in
+                  (tmp_path / "rep_r0.jsonl").read_text().splitlines()]
+        assert any(r.get("event") == "fault"
+                   and r.get("kind") == "kill" for r in r0recs)
+        r1recs = [json.loads(line) for line in
+                  (tmp_path / "rep_r1.jsonl").read_text().splitlines()]
+        assert any(r.get("event") == "fault"
+                   and r.get("kind") == "stall" for r in r1recs)
+        # breaker tripped (death force-open at least); keep stepping
+        # until (a) a tripped breaker recovered via the half-open
+        # probe against its respawned replica and (b) r2's frozen
+        # heartbeat was detected as a HANG (20 s staleness) and
+        # stamped with its class
+        assert router.counters["breaker_trips"] >= 1
+
+        def hang_stamped():
+            return any(e.get("kind") == "restart_downtime"
+                       and e.get("fail_class") == "hang"
+                       for e in router.events
+                       if e["event"] == "ledger")
+
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 120.0:
+            router.step()
+            if hang_stamped() and any(
+                    br.state == "closed" and br.trips
+                    for br in router._breakers.values()):
+                break
+            time.sleep(0.05)
+        recovered = [n for n, br in router._breakers.items()
+                     if br.trips and br.state == "closed"]
+        assert recovered, {n: br.state
+                           for n, br in router._breakers.items()}
+        assert hang_stamped(), [e for e in router.events
+                                if e["event"] == "ledger"]
+        assert router.counters["respawns"] >= 1
+    finally:
+        router.shutdown()
+        collector.stop()
+        srv.close()
+    # --goodput over the router log: per-replica MTTR + availability
+    assert validate_file(log) == []
+    rep = run_goodput(log)
+    fl = rep["fleet"]
+    assert fl["failovers"] >= 1 and fl["breaker_trips"] >= 1
+    assert fl["mttr"], fl
+    for m in fl["mttr"].values():
+        assert m["count"] >= 1 and m["mttr_s"] > 0
+    assert fl["fleet_availability"] is not None
+    assert fl["fleet_availability"] >= 0.5
+    out = format_report(rep)
+    assert "fleet availability" in out

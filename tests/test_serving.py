@@ -761,6 +761,77 @@ def test_spec_telemetry_schema_v9_and_status_surface(params, tmp_path):
          "spec_accept_rate": "high"}) != []
 
 
+# --------------------------- drain + cross-engine failover (round 15)
+
+
+def test_engine_drain_typed_rejection_and_completion(params):
+    """Graceful drain: accepted work (queued AND running) completes,
+    new submits raise the typed EngineDraining (the old post-drain
+    behavior was implicit), drain() reports completion, and the
+    allocator balances — the replica-side half of the router's
+    scale-down path."""
+    from shallowspeed_tpu.serving import EngineDraining
+
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=2, prefill_chunk=16)
+    oracle = {k: solo(params, toks(70 + i, t=10), 6, temperature=0.0)
+              for i, k in enumerate("abc")}
+    for i, k in enumerate("abc"):      # c queues behind 2 slots
+        eng.submit(toks(70 + i, t=10), 6, rid=k)
+    eng.step()
+    assert eng.drain() is False        # in-flight work remains
+    with pytest.raises(EngineDraining) as ei:
+        eng.submit(toks(80, t=8), 4, rid="late")
+    assert ei.value.pending == 3
+    res = eng.run()
+    for k, ref in oracle.items():
+        np.testing.assert_array_equal(res[k], ref, err_msg=k)
+    assert eng.drain() is True         # idempotent, now complete
+    assert eng.alloc.n_free == eng.alloc.n_usable
+    # shed-pause is a different mechanism and must still resume;
+    # draining is one-way
+    assert eng.draining and not eng.admission_paused
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"temperature": 0.0},
+    {"temperature": 0.7, "seed": 11},
+], ids=["greedy", "sampled"])
+def test_failover_continuation_on_fresh_engine_matches_solo(params,
+                                                            kwargs):
+    """The cross-process failover mechanism at the engine level (the
+    fleet drill's in-process canary): decode a request halfway on one
+    engine, then re-submit prompt + tokens-so-far on a FRESH engine
+    instance (`submit(generated=)` — a different process in the
+    drill). The continuation re-prefills and keeps drawing from
+    `fold_in(PRNGKey(seed), i)` at the continued indices, so the
+    completed stream is token-identical to the solo `generate()`
+    oracle."""
+    prompt = toks(33, t=14)
+    ref = solo(params, prompt, 10, **kwargs)
+    eng1 = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=16)
+    eng1.submit(prompt, 10, temperature=kwargs.get("temperature", 0.0),
+                seed=kwargs.get("seed", 0), rid="q")
+    while len(eng1.poll("q")["tokens"]) < 4:   # mid-decode "death"
+        eng1.step()
+    prefix = [int(t) for t in eng1.poll("q")["tokens"]]
+    assert 4 <= len(prefix) < 10
+    np.testing.assert_array_equal(prefix, ref[:len(prefix)])
+    eng2 = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                         max_slots=4, prefill_chunk=16)
+    eng2.submit(prompt, 10,
+                temperature=kwargs.get("temperature", 0.0),
+                seed=kwargs.get("seed", 0), rid="q",
+                generated=prefix)
+    res = eng2.run()
+    np.testing.assert_array_equal(res["q"], ref)
+    assert eng2.alloc.n_free == eng2.alloc.n_usable
+    # a continuation that already has everything is a caller bug
+    with pytest.raises(ValueError, match="nothing left"):
+        eng2.submit(prompt, 4, rid="full", generated=[1, 2, 3, 4])
+
+
 # ------------------------------- satellites: rebucket + atomicity
 
 
