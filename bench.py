@@ -723,6 +723,24 @@ def bench_serving() -> dict:
                 - next(p["wall"] for p in tl if p["phase"] == "admitted")
                 for tl in eng.timelines.values()
                 if any(p["phase"] == "decoding" for p in tl)]
+            # waterfall components (round 16): the same phase ->
+            # rq_* mapping the stitcher and the live monitor use,
+            # reduced over the retained timelines — the serving
+            # sweep's latency now names where it goes per level
+            from shallowspeed_tpu.telemetry.tracing import (
+                PHASE_COMPONENT)
+
+            comp_ms = {"rq_queue": [], "rq_prefill": [],
+                       "rq_decode": []}
+            for tl in eng.timelines.values():
+                by = {}
+                for a, b in zip(tl, tl[1:]):
+                    c = PHASE_COMPONENT.get(a["phase"])
+                    if c in comp_ms:
+                        by[c] = by.get(c, 0.0) \
+                            + (b["wall"] - a["wall"]) * 1e3
+                for c, v in by.items():
+                    comp_ms[c].append(v)
             out = {"offered": n, "wall_s": round(wall, 3),
                    "tok_per_sec": round(toks / wall, 2),
                    "ttft_p50_ms": round(p50("ttft_ms"), 2),
@@ -730,6 +748,10 @@ def bench_serving() -> dict:
                    "prefill_p50_ms": round(
                        float(np.median(prefill)) * 1e3, 2)
                    if prefill else None}
+            for c, vals in comp_ms.items():
+                if vals:
+                    out[f"{c}_p50_ms"] = round(
+                        float(np.median(vals)), 2)
             if eng.spec_k:
                 d = eng.counters["spec_drafted"]
                 out["ticks"] = eng.counters["ticks"]

@@ -29,7 +29,15 @@ decision lands in `--log-file` (schema v10: "route"/"failover"/
     python -m shallowspeed_tpu.telemetry --goodput run/router.jsonl
 
 reports request percentiles, per-replica MTTR, and fleet availability
-from the router log alone. Fleet chaos drills: `--chaos-fleet
+from the router log alone — and, with the per-replica logs appended,
+the per-request latency waterfall block (schema v11 trace context).
+The whole fleet's logs stitch onto one skew-corrected timeline:
+
+    python -m shallowspeed_tpu.telemetry --trace-stitch \
+        run/router.jsonl run/replica_r*.jsonl --out trace.json
+
+(Perfetto-loadable; every failover visible as a gap on the failed-over
+request's journey track.) Fleet chaos drills: `--chaos-fleet
 'r0=kill@6;r1=stall@4:0.5' --chaos-state DIR` hands each named
 replica its own seeded fault plan.
 

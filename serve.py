@@ -320,9 +320,14 @@ def main(argv=None) -> int:
                 r = reqs[i]
                 i += 1
                 try:
+                    # a request line may carry its own trace id (an
+                    # upstream edge's context); absent, the engine
+                    # mints one so standalone lifecycle streams still
+                    # stitch (schema v11)
                     eng.submit(r["prompt"], r["max_new"],
                                temperature=r.get("temperature", 0.0),
-                               seed=r.get("seed", 0), rid=r["id"])
+                               seed=r.get("seed", 0), rid=r["id"],
+                               trace=r.get("trace"))
                 except (KeyError, TypeError, ValueError) as e:
                     # one bad request (too long for max_seq/pool,
                     # duplicate id, missing/mistyped fields) must not

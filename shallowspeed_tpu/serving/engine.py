@@ -102,6 +102,7 @@ from shallowspeed_tpu import chaos
 from shallowspeed_tpu.models import generate as G
 from shallowspeed_tpu.ops.flash_attention import paged_flash_decode
 from shallowspeed_tpu.telemetry.trace import tracer
+from shallowspeed_tpu.telemetry.tracing import new_span_id, new_trace_id
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.models.kv_cache import masked_attention
 from shallowspeed_tpu.serving.cache import (SCRATCH_BLOCK, BlockAllocator,
@@ -302,7 +303,8 @@ class _Req:
                  "table", "written", "admit_seq", "admit_t",
                  "queued_at", "wait_s", "first_tok_t", "last_tok",
                  "timeline", "track", "trace_t0", "n_drafted",
-                 "n_accepted", "ctx_ids", "spec_idx")
+                 "n_accepted", "ctx_ids", "spec_idx",
+                 "trace", "span", "parent", "attempt")
 
     def __init__(self, rid, prompt, max_new, temp, seed, arrival):
         self.rid = rid
@@ -335,6 +337,15 @@ class _Req:
         self.n_accepted = 0
         self.ctx_ids = None
         self.spec_idx = None
+        # trace context (schema v11, telemetry/tracing.py): trace id
+        # propagated from the fleet router (or minted here for
+        # standalone serving), this engine attempt's own span id, the
+        # upstream dispatch span, and the 0-based cross-engine
+        # dispatch attempt counter
+        self.trace = None
+        self.span = None
+        self.parent = None
+        self.attempt = 0
 
 
 class ServingEngine:
@@ -441,7 +452,8 @@ class ServingEngine:
 
     def submit(self, prompt, max_new: int, temperature: float = 0.0,
                seed: int = 0, rid: str | None = None,
-               generated=()) -> str:
+               generated=(), trace: str | None = None,
+               parent: str | None = None, attempt: int = 0) -> str:
         """Queue one request. Rejects (typed ValueError) requests that
         could never run: prompt + max_new past cfg.max_seq, or a block
         footprint larger than the whole pool (the no-deadlock
@@ -457,7 +469,14 @@ class ServingEngine:
         token-identical to the solo `generate()` stream no matter which
         engine emitted the prefix (the fleet router's seeded idempotent
         re-dispatch rides this). `max_new` stays the TOTAL budget; the
-        result stream includes the resumed prefix."""
+        result stream includes the resumed prefix.
+
+        `trace`/`parent`/`attempt` are the schema-v11 trace context
+        the router propagates (one trace per fleet request, `parent`
+        = the dispatch span, `attempt` = the 0-based cross-engine
+        dispatch counter); standalone submissions mint their own
+        trace so a lone serve.py's lifecycle stream still stitches.
+        This engine mints a fresh span per attempt either way."""
         if self.draining:
             raise EngineDraining(self.pending())
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -488,6 +507,12 @@ class ServingEngine:
             raise ValueError(f"duplicate request id {rid!r}")
         req = _Req(rid, prompt, max_new, temperature, seed,
                    self.clock())
+        req.trace = trace if isinstance(trace, str) and trace \
+            else new_trace_id()
+        req.span = new_span_id()
+        req.parent = parent if isinstance(parent, str) and parent \
+            else None
+        req.attempt = max(0, int(attempt))
         if generated:
             # resume mid-stream: identical state to a post-eviction
             # requeue — ctx re-prefills prompt + prefix, the next
@@ -592,7 +617,15 @@ class ServingEngine:
         if self.metrics is not None:
             rec = {"id": req.rid, "phase": phase,
                    "seq": len(req.timeline) - 1,
-                   "tick": self.counters["ticks"], **extra}
+                   "tick": self.counters["ticks"],
+                   # schema v11: the cross-process join keys — one
+                   # trace per fleet request, one span per engine
+                   # attempt, attempt = the cross-engine dispatch
+                   # counter the (rid, attempt) reduction keys on
+                   "trace": req.trace, "span": req.span,
+                   "attempt": req.attempt, **extra}
+            if req.parent is not None:
+                rec["parent"] = req.parent
             if req.slot is not None:
                 rec["slot"] = req.slot
             if prev is not None:
@@ -974,6 +1007,11 @@ class ServingEngine:
             "wait_ms": round(req.wait_s * 1e3, 3),
             "queue_depth": len(self.queue),
             "preempted": req.n_preempt,
+            # schema v11: trace context on the completion record too,
+            # so a replica log's request line joins its own lifecycle
+            # stream and the router's fleet-edge record by trace id
+            "trace": req.trace, "span": req.span,
+            "attempt": req.attempt,
         }
         if len(req.generated) > 1:
             rec["tpot_ms"] = round(

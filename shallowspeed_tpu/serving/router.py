@@ -92,6 +92,7 @@ from shallowspeed_tpu.elastic import (RestartPolicy, classify_exit,
                                       read_heartbeat_status,
                                       write_heartbeat)
 from shallowspeed_tpu.telemetry.monitor import parse_slos
+from shallowspeed_tpu.telemetry.tracing import new_span_id, new_trace_id
 
 
 class FleetOverloaded(RuntimeError):
@@ -200,12 +201,21 @@ def _submit_typed(engine, payload: dict) -> dict:
 
     rid = str(payload.get("id"))
     try:
+        att = payload.get("attempt")
         engine.submit(np.asarray(payload["prompt"], np.int32),
                       int(payload["max_new"]),
                       temperature=float(payload.get("temperature",
                                                     0.0)),
                       seed=int(payload.get("seed", 0)), rid=rid,
-                      generated=payload.get("generated") or ())
+                      generated=payload.get("generated") or (),
+                      # schema v11 trace context: minted by the
+                      # router, riding the POST /submit body — a
+                      # failover re-dispatch carries the SAME trace
+                      # with an incremented attempt
+                      trace=payload.get("trace"),
+                      parent=payload.get("parent"),
+                      attempt=int(att) if isinstance(att, int)
+                      and not isinstance(att, bool) else 0)
     except EngineDraining:
         return {"ok": False, "error": "EngineDraining",
                 "retry_after": 1.0}
@@ -673,7 +683,7 @@ class _RouterReq:
                  "submit_t", "deadline", "tokens", "replica",
                  "dispatch_t", "last_progress_t", "first_tok_t",
                  "failovers", "failover_from", "failover_reason",
-                 "exclude")
+                 "exclude", "trace", "span", "attempt")
 
     def __init__(self, rid, prompt, max_new, temp, seed, now,
                  deadline):
@@ -693,6 +703,13 @@ class _RouterReq:
         self.failover_from: str | None = None
         self.failover_reason: str | None = None
         self.exclude: str | None = None   # skip on the next dispatch
+        # trace context (schema v11): one trace id for the request's
+        # whole fleet journey, a root span for the router's custody,
+        # and the 0-based cross-engine dispatch attempt counter the
+        # per-replica lifecycle events echo back
+        self.trace = new_trace_id()
+        self.span = new_span_id()
+        self.attempt = -1                 # first dispatch -> 0
 
 
 class Router:
@@ -1037,11 +1054,19 @@ class Router:
     def _finalize(self, req: _RouterReq, now: float, status: str,
                   error: str | None = None) -> None:
         self.inflight.pop(req.rid, None)
+        # e2e from a FRESH clock read, not the step-loop `now`: the
+        # request record's log stamp is the stitcher's finish mark,
+        # and a stale `now` (captured before this step's polls or an
+        # in-process engine's compile) would make the record's e2e
+        # disagree with its own stamp by that lag — which the
+        # waterfall would book as rq_unexplained
         rec = {"id": req.rid, "status": status,
                "replica": req.replica, "failovers": req.failovers,
+               "trace": req.trace, "span": req.span,
                "tokens_in": int(req.prompt.shape[0]),
                "tokens_out": len(req.tokens),
-               "e2e_ms": round((now - req.submit_t) * 1e3, 3)}
+               "e2e_ms": round(
+                   (self.clock() - req.submit_t) * 1e3, 3)}
         if req.first_tok_t is not None:
             rec["ttft_ms"] = round(
                 (req.first_tok_t - req.submit_t) * 1e3, 3)
@@ -1141,12 +1166,27 @@ class Router:
                     continue
                 ranked = sorted(scores, key=lambda n: (scores[n], n))
             sent = False
+            # one dispatch span per dispatch round; the engine's
+            # lifecycle spans parent to it, so a failover's re-prefill
+            # hangs off the RE-dispatch, not the original
+            span_k = new_span_id()
+            attempt_next = req.attempt + 1
             payload = {"id": req.rid,
                        "prompt": [int(t) for t in req.prompt],
                        "max_new": req.max_new,
                        "temperature": req.temp, "seed": req.seed,
-                       "generated": list(req.tokens)}
+                       "generated": list(req.tokens),
+                       "trace": req.trace, "parent": span_k,
+                       "attempt": attempt_next}
             for name in ranked:
+                # pre-POST clock pair: the ONLY router stamp that
+                # happens-before the replica's lifecycle "submit"
+                # (the route/failover event itself is emitted AFTER
+                # the gateway accepted, i.e. after that stamp) — the
+                # stitcher's skew fit needs this lower bound, and
+                # pre->event brackets one dispatch transaction
+                # (telemetry/tracing._fit_offsets)
+                pre_wall, pre_mono = time.time(), time.monotonic()
                 try:
                     resp = self._replicas[name]["handle"].submit(
                         payload)
@@ -1179,6 +1219,7 @@ class Router:
                 req.replica = name
                 req.dispatch_t = now
                 req.last_progress_t = now
+                req.attempt = attempt_next
                 self.inflight[req.rid] = req
                 scores[name] = scores.get(name, 0.0) + 1.0
                 if req.failover_from is not None:
@@ -1187,7 +1228,11 @@ class Router:
                     self._emit("failover", id=req.rid, replica=name,
                                reason=req.failover_reason or "?",
                                tokens_done=len(req.tokens),
-                               attempt=req.failovers,
+                               attempt=req.attempt,
+                               trace=req.trace, span=span_k,
+                               parent=req.span,
+                               dispatch_wall=round(pre_wall, 6),
+                               dispatch_mono=round(pre_mono, 6),
                                **{"from": req.failover_from})
                     req.failover_from = None
                     req.failover_reason = None
@@ -1195,7 +1240,21 @@ class Router:
                     self.counters["routes"] += 1
                     self._emit("route", id=req.rid, replica=name,
                                queue_depth=len(self.pending),
-                               score=round(scores[name] - 1.0, 3))
+                               score=round(scores[name] - 1.0, 3),
+                               trace=req.trace, span=span_k,
+                               parent=req.span,
+                               dispatch_wall=round(pre_wall, 6),
+                               dispatch_mono=round(pre_mono, 6),
+                               # fresh clock, not the step-loop
+                               # `now`: the stitcher derives the
+                               # fleet-edge submit time as (this
+                               # line's log stamp - wait_ms), so
+                               # wait_ms must be measured AT emission
+                               # or the dispatch lag (an in-process
+                               # engine compile) lands in rq_queue
+                               wait_ms=round(
+                                   (self.clock() - req.submit_t)
+                                   * 1e3, 3))
                 sent = did = True
                 break
             if not sent:

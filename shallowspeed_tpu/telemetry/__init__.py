@@ -89,6 +89,12 @@ _LAZY = {
     "FleetCollector": "fleet", "Replica": "fleet",
     "format_fleet_status": "fleet",
     "request_timeline": "report",
+    # distributed request tracing (round 16): trace context, the
+    # cross-process stitcher, the per-request latency waterfall
+    "new_trace_id": "tracing", "new_span_id": "tracing",
+    "stitch": "tracing", "goodput_block": "tracing",
+    "PHASE_COMPONENT": "tracing",
+    "request_waterfall": "report",
 }
 
 

@@ -6,6 +6,11 @@ run's goodput ledger, or watch a run live.
     python -m shallowspeed_tpu.telemetry --regress BENCH_*.json
     python -m shallowspeed_tpu.telemetry --regress .
     python -m shallowspeed_tpu.telemetry --goodput run/metrics.jsonl
+    python -m shallowspeed_tpu.telemetry --goodput run/router.jsonl \
+        run/replica_r0.jsonl run/replica_r1.jsonl
+    python -m shallowspeed_tpu.telemetry --trace-stitch \
+        run/router.jsonl run/replica_r0.jsonl run/replica_r1.jsonl \
+        --out stitched.json
     python -m shallowspeed_tpu.telemetry --live run/metrics.jsonl
     python -m shallowspeed_tpu.telemetry --live f.jsonl --once
     python -m shallowspeed_tpu.telemetry --fleet http://127.0.0.1:9100 \
@@ -17,7 +22,14 @@ run's goodput ledger, or watch a run live.
 both pure-stdlib checks that cost only the package import (~1 s), not
 a trace or a bench run of anything. --goodput prints the run-level
 wall-clock decomposition (goodput + named losses) of one metrics
-JSONL, including runs that span supervisor restarts. --live tails a
+JSONL, including runs that span supervisor restarts; extra files
+after the first are replica logs joined BY TRACE ID into the
+per-request waterfall (tracing) block. --trace-stitch joins a
+router log + N replica logs (schema v11 trace context) on one
+skew-corrected timeline and writes a Perfetto-loadable Chrome trace
+(--out) with per-replica tracks and a per-request journey track —
+queue-wait -> dispatch -> prefill -> decode -> failover gap ->
+re-prefill -> decode -> finish (telemetry/tracing.py). --live tails a
 GROWING metrics JSONL and renders the same view the --monitor-port
 /status.json endpoint serves (streaming sketch quantiles, goodput so
 far, health, SLO burn rates with --slo) — live monitoring for runs
@@ -47,13 +59,23 @@ def main(argv=None) -> int:
                    help="BENCH_r*.json files (or directories scanned "
                         "for them) — fail when the newest round drops "
                         "below the prior rounds beyond the noise band")
-    g.add_argument("--goodput", metavar="JSONL",
+    g.add_argument("--goodput", nargs="+", metavar="JSONL",
                    help="reduce one metrics JSONL to the goodput "
                         "report (wall-clock decomposition + losses, "
                         "per-failure-class MTTR, availability, the "
                         "injected-fault tally on chaos drills, and "
                         "p50/p95 ttft/tpot on serving runs with "
-                        "schema-v6 request events)")
+                        "schema-v6 request events); extra files are "
+                        "replica logs joined by trace id into the "
+                        "per-request waterfall block (schema v11)")
+    g.add_argument("--trace-stitch", nargs="+", metavar="JSONL",
+                   help="join a router log + N replica logs on one "
+                        "skew-corrected timeline (schema-v11 trace "
+                        "context; per-stanza offsets fitted from the "
+                        "router's dispatch/ack pairs) and write a "
+                        "Perfetto-loadable Chrome trace to --out; "
+                        "prints the clock fit and each request's "
+                        "latency waterfall")
     g.add_argument("--live", metavar="JSONL",
                    help="tail a growing metrics JSONL and render the "
                         "live status view (the /status.json surface "
@@ -82,7 +104,20 @@ def main(argv=None) -> int:
     p.add_argument("--log-file", default=None,
                    help="with --fleet: append straggler/alert events "
                         "(schema v8) to this JSONL")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="with --trace-stitch: where the Chrome trace "
+                        "JSON lands (default: stitched_trace.json "
+                        "next to the first input)")
     args = p.parse_args(argv)
+
+    if args.trace_stitch:
+        from shallowspeed_tpu.telemetry.tracing import stitch_main
+
+        out = args.out
+        if out is None:
+            out = str(Path(args.trace_stitch[0]).parent
+                      / "stitched_trace.json")
+        return stitch_main(args.trace_stitch, out=out)
 
     if args.fleet:
         from shallowspeed_tpu.telemetry.fleet import fleet_main
@@ -105,7 +140,8 @@ def main(argv=None) -> int:
         from shallowspeed_tpu.telemetry.goodput import (format_report,
                                                         run_goodput)
 
-        print(format_report(run_goodput(args.goodput)))
+        print(format_report(run_goodput(
+            args.goodput[0], extra_paths=args.goodput[1:])))
         return 0
 
     from shallowspeed_tpu.telemetry.schema import validate_file

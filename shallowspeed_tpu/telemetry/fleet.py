@@ -77,7 +77,8 @@ from pathlib import Path
 from shallowspeed_tpu.telemetry.anomaly import RobustEWMA
 from shallowspeed_tpu.telemetry.monitor import (EXEMPLAR_K, FileTailer,
                                                 FlightRecorder, Monitor,
-                                                parse_slos, prom_escape)
+                                                parse_slos, prom_escape,
+                                                prom_histogram_lines)
 from shallowspeed_tpu.telemetry.sketch import LogHistogram, MetricSketches
 
 # per-replica quantile metrics the straggler detector watches, and the
@@ -618,6 +619,11 @@ class FleetCollector:
                                  key=lambda s: (s["replica"],
                                                 s["metric"])),
             "worst_ttft": self.worst("ttft_ms"),
+            # the fleet's slowest finished request WITH its latency
+            # decomposition (round 16): the worst per-replica
+            # slowest_request, replica-labelled — "which request,
+            # which replica, which component" in one read
+            "slowest_request": self._slowest_request(names),
             "counters": dict(self.counters),
         }
         if skipped:
@@ -625,6 +631,17 @@ class FleetCollector:
         if self.flight is not None:
             out["flight_dumps"] = list(self.flight.dumps)
         return out
+
+    def _slowest_request(self, names: dict) -> dict | None:
+        worst = None
+        for rep in self.replicas:
+            sr = (rep._status or {}).get("slowest_request")
+            if isinstance(sr, dict) \
+                    and isinstance(sr.get("e2e_ms"), (int, float)) \
+                    and (worst is None
+                         or sr["e2e_ms"] > worst["e2e_ms"]):
+                worst = {**sr, "replica": names[rep.uid]}
+        return worst
 
     def prometheus(self) -> str:
         """Replica-labelled Prometheus exposition — label values go
@@ -660,6 +677,13 @@ class FleetCollector:
                                  f'{sk.total:.6g}')
                     lines.append(f'{base}_count{{replica="{lbl}"}} '
                                  f'{sk.n}')
+                # native histograms on the SHARED le ladder (round
+                # 16): per-replica cumulative buckets sum — the form
+                # in which Prometheus fleet quantiles are correct
+                for j, (lbl, sk) in enumerate(entries):
+                    lines.extend(prom_histogram_lines(
+                        base, sk, label=f'replica="{lbl}",',
+                        type_line=(j == 0)))
             lines.append(f"# TYPE {P}straggler gauge")
             for _key, rec in sorted(self.stragglers.items()):
                 lines.append(

@@ -43,7 +43,6 @@ names what was injected next to what it cost.
 from __future__ import annotations
 
 import json
-from pathlib import Path
 
 # Pause kinds StepRates excludes from its throughput windows. Anything
 # else noted with seconds is treated as an in-window loss.
@@ -104,17 +103,9 @@ def stamp_ledger_line(path, kind: str, **fields) -> None:
 
 
 def _parse(path) -> list[dict]:
-    out = []
-    for line in Path(path).read_text().splitlines():
-        if not line.strip():
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(rec, dict) and "event" in rec:
-            out.append(rec)
-    return out
+    from shallowspeed_tpu.telemetry.schema import parse_metrics_jsonl
+
+    return parse_metrics_jsonl(path)
 
 
 def _wall(rec, stanza_start_wall) -> float | None:
@@ -126,7 +117,7 @@ def _wall(rec, stanza_start_wall) -> float | None:
     return None
 
 
-def run_goodput(path) -> dict:
+def run_goodput(path, extra_paths=()) -> dict:
     """Reduce one metrics JSONL (one run, possibly spanning supervisor
     restarts) to the goodput report. Returns
 
@@ -137,6 +128,12 @@ def run_goodput(path) -> dict:
     `accounted_frac` = (productive + sum(losses)) / wall_clock — the
     acceptance bar is >= 0.95 on a kill/resume run; anything below
     that means time went somewhere the ledger has no name for.
+
+    `extra_paths` (schema v11): additional per-process JSONLs — a
+    router log's replica files — joined BY TRACE ID into the
+    ``tracing`` block (per-request latency waterfalls, p50/p95 per
+    component, worst-``rq_unexplained`` exemplars); the wall-clock /
+    ledger reduction above stays scoped to the primary file.
     """
     recs = _parse(path)
     # split into stanzas at run_start lines
@@ -335,7 +332,22 @@ def run_goodput(path) -> dict:
         # restart_downtime stamps — not stanza gaps — carry the
         # fleet's downtime story)
         "fleet": _fleet_block(recs, wall),
+        # None without schema-v11 trace-context lifecycle events —
+        # the per-request latency waterfalls, skew-corrected and
+        # joined by trace id across this file + extra_paths
+        # (telemetry/tracing.goodput_block; `recs` forwarded so the
+        # primary log is parsed once, not twice)
+        "tracing": _tracing_block([path, *extra_paths], recs),
     }
+
+
+def _tracing_block(paths, first_recs) -> dict | None:
+    from shallowspeed_tpu.telemetry.tracing import goodput_block
+
+    try:
+        return goodput_block(paths, first_recs=first_recs)
+    except OSError:
+        return None
 
 
 def _fleet_block(recs, wall: float) -> dict | None:
@@ -550,6 +562,21 @@ def format_report(rep: dict) -> str:
         if fl["fleet_availability"] is not None:
             lines.append(
                 f"  fleet availability {fl['fleet_availability']:.2%}")
+    tr = rep.get("tracing")
+    if tr:
+        comps = "  ".join(
+            f"{name[3:]} {c['p50_ms']:.0f}/{c['p95_ms']:.0f}"
+            for name, c in tr["components"].items())
+        lines.append(
+            f"tracing ({tr['requests']} request(s), e2e p50 "
+            f"{tr['e2e_p50_ms']:.0f} ms) p50/p95 ms: {comps}")
+        worst = tr["worst_unexplained"][0] \
+            if tr["worst_unexplained"] else None
+        if worst and abs(worst["rq_unexplained_ms"]) >= 1.0:
+            lines.append(
+                f"  worst unexplained: request {worst['id']} "
+                f"({worst['rq_unexplained_ms']:.1f} ms of "
+                f"{worst['e2e_ms']:.1f} ms e2e)")
     mon = rep.get("monitor")
     if mon:
         qs = mon["quantiles"]

@@ -70,6 +70,47 @@ CORE_SKETCHES = ("step_ms", "ttft_ms", "tpot_ms", "tok_s",
 EXEMPLAR_METRICS = ("ttft_ms", "tpot_ms")
 EXEMPLAR_K = 5
 
+# native-Prometheus-histogram bucket ladder (round 16): a FIXED,
+# data-independent {1, 2.5, 5} x 10^k grid so every replica exports
+# the same `le` boundaries — which is the whole point: cumulative
+# bucket counts SUM across replicas, so fleet quantiles computed by
+# histogram_quantile() in Prometheus/Grafana are correct, where
+# averaging the pre-computed per-replica quantile labels of the
+# summary export is not. Spans sub-ms ttft to multi-minute e2e; the
+# counts at each boundary come from the log-bucketed sketch at its
+# documented rel_err.
+HIST_LE = tuple(m * 10.0 ** k for k in range(-1, 6)
+                for m in (1.0, 2.5, 5.0))
+
+# the cap on retained in-flight lifecycle accumulations (one dict per
+# live request id) — a monitor on a long-lived replica must stay O(1)
+LIFECYCLE_CAP = 1024
+
+
+def prom_histogram_lines(base: str, sk: LogHistogram,
+                         label: str = "",
+                         type_line: bool = True) -> list[str]:
+    """Render one sketch as a native Prometheus histogram
+    (`<base>_hist_bucket{le=...}` cumulative counts + `_sum`/`_count`)
+    on the shared HIST_LE ladder. `label` is an optional extra label
+    clause (e.g. 'replica="r0",') spliced before `le`; pass
+    `type_line=False` for every series after the first of one metric
+    (the exposition format wants ONE # TYPE per metric name)."""
+    lines = [f"# TYPE {base}_hist histogram"] if type_line else []
+    for le in HIST_LE:
+        lines.append(f'{base}_hist_bucket{{{label}le="{le:g}"}} '
+                     f"{sk.count_le(le)}")
+    lines.append(f'{base}_hist_bucket{{{label}le="+Inf"}} {sk.n}')
+    if label:
+        lines.append(f"{base}_hist_sum{{{label.rstrip(',')}}} "
+                     f"{sk.total:.6g}")
+        lines.append(f"{base}_hist_count{{{label.rstrip(',')}}} "
+                     f"{sk.n}")
+    else:
+        lines.append(f"{base}_hist_sum {sk.total:.6g}")
+        lines.append(f"{base}_hist_count {sk.n}")
+    return lines
+
 
 class PortInUseError(OSError):
     """--monitor-port names a port this process cannot bind."""
@@ -327,6 +368,12 @@ class Monitor:
         self.last_fault: dict | None = None
         self.last_step: dict | None = None
         self.serving: dict = {}
+        # per-request lifecycle accounting (round 16): in-flight
+        # phase-time accumulation keyed by request id, reduced on
+        # "finished" into the rq_* component sketches and the
+        # slowest-request decomposition /status.json serves
+        self._lifecycle_acc: dict[str, dict] = {}
+        self.slowest_request: dict | None = None
         self.active_alerts: dict[str, dict] = {}
         self._first_wall: float | None = None
         self._last_wall: float | None = None
@@ -457,6 +504,45 @@ class Monitor:
                     rule.sketch, rule.sketch))
                 if isinstance(v, (int, float)):
                     rule.record(float(v), now)
+
+    def _on_lifecycle(self, rec: dict) -> None:
+        """Accumulate one request's phase transitions into the rq_*
+        waterfall components (telemetry/tracing.PHASE_COMPONENT — the
+        same mapping the offline stitcher uses), feeding the
+        per-component sketches on completion and keeping the
+        slowest-request decomposition for /status.json. Engine-side
+        components only (queue/prefill/decode); the cross-process
+        pieces (failover gap, breaker wait) are the stitcher's."""
+        from shallowspeed_tpu.telemetry.tracing import PHASE_COMPONENT
+
+        rid = rec.get("id")
+        if not isinstance(rid, str):
+            return
+        st = self._lifecycle_acc.get(rid)
+        if st is None:
+            while len(self._lifecycle_acc) >= LIFECYCLE_CAP:
+                self._lifecycle_acc.pop(
+                    next(iter(self._lifecycle_acc)))
+            st = self._lifecycle_acc[rid] = {
+                "by": {}, "trace": rec.get("trace")}
+        ms = rec.get("ms_in_prev")
+        prev = rec.get("prev")
+        if isinstance(ms, (int, float)) and isinstance(prev, str):
+            comp = PHASE_COMPONENT.get(prev)
+            if comp is not None:
+                st["by"][comp] = st["by"].get(comp, 0.0) + float(ms)
+        if rec.get("phase") != "finished":
+            return
+        st = self._lifecycle_acc.pop(rid)
+        total = sum(st["by"].values())
+        for comp, v in st["by"].items():
+            self.sketches.observe(comp + "_ms", v)
+        if total > (self.slowest_request or {}).get("e2e_ms", -1.0):
+            self.slowest_request = {
+                "id": rid, "trace": st["trace"],
+                "e2e_ms": round(total, 3),
+                "by_component_ms": {k: round(v, 3) for k, v
+                                    in sorted(st["by"].items())}}
 
     def _on_ledger(self, rec: dict) -> None:
         secs = rec.get("seconds")
@@ -650,6 +736,10 @@ class Monitor:
                 "health": self.health,
                 "last_step": self.last_step,
                 "serving": self.serving or None,
+                # the slowest finished request's per-component
+                # decomposition (round 16) — where ITS latency went,
+                # one hop from the burning quantile
+                "slowest_request": self.slowest_request,
                 "last_fault": self.last_fault,
                 "slo": [r.status(now) for r in self.rules],
                 "alerts": sorted(self.active_alerts.values(),
@@ -677,6 +767,11 @@ class Monitor:
                     lines.append(f'{base}{{quantile="{q}"}} {v:.6g}')
                 lines.append(f"{base}_sum {sk.total:.6g}")
                 lines.append(f"{base}_count {sk.n}")
+                # ... and the NATIVE histogram alongside (round 16):
+                # cumulative le buckets on the fixed ladder, so fleet
+                # quantiles aggregate correctly in Prometheus instead
+                # of averaging pre-computed per-replica quantiles
+                lines.extend(prom_histogram_lines(base, sk))
             for name, v in (("goodput_so_far", self.goodput_so_far()),
                             ("availability", self.availability())):
                 if v is not None:

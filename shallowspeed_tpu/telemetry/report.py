@@ -95,12 +95,24 @@ def request_timeline(source, rid: str | None = None) -> dict:
         {"phases": [{"phase", "wall", "ms_in_prev", ...}, ...],
          "by_phase_ms": {phase: total ms spent IN that phase},
          "complete": started with submit and ended with finished,
+         "attempts": cross-engine dispatch attempts merged,
          "e2e_ms": submit -> finished wall span (None if incomplete)}
 
     Time spent "in" a phase is attributed by the NEXT transition's
     ms_in_prev (or wall delta when absent), so the sum of by_phase_ms
     reconciles with e2e_ms up to stamp rounding — the fleet view's
-    worst-ttft exemplar resolves to which PHASE through this."""
+    worst-ttft exemplar resolves to which PHASE through this.
+
+    One rid's events can span MULTIPLE engine attempts (a failover
+    re-dispatch; schema v11 stamps ``attempt``, and a resumed attempt
+    opens with ``submit`` carrying the ``resumed`` marker). The
+    reduction is keyed on (rid, attempt): each attempt's seq counter
+    restarts at 0, so a rid-only sort would interleave two attempts'
+    events, and the wall-delta fallback across two PROCESSES' clocks
+    would book the cross-attempt gap — arbitrary skew — into a phase.
+    Attempts merge in order; no ms is attributed across an attempt
+    boundary (the stitched waterfall's rq_failover_gap owns that
+    interval, with skew corrected)."""
     if isinstance(source, (str, Path)):
         recs = []
         for line in Path(source).read_text().splitlines():
@@ -112,47 +124,97 @@ def request_timeline(source, rid: str | None = None) -> dict:
                 continue
     else:
         recs = list(source)
-    per: dict[str, list] = {}
+    per: dict[str, dict[int, list]] = {}
+    seen_submits: dict[str, int] = {}
     for rec in recs:
         if not isinstance(rec, dict) or rec.get("event") != "lifecycle":
             continue
         r = rec.get("id")
         if not isinstance(r, str) or (rid is not None and r != rid):
             continue
-        per.setdefault(r, []).append(rec)
+        att = rec.get("attempt")
+        if not isinstance(att, int) or isinstance(att, bool):
+            # pre-v11 logs: derive the attempt index from the resumed
+            # markers — every "submit" after the first opens a new one
+            if rec.get("phase") == "submit":
+                seen_submits[r] = seen_submits.get(r, -1) + 1
+            att = max(0, seen_submits.get(r, 0))
+        per.setdefault(r, {}).setdefault(att, []).append(rec)
     out = {}
-    for r, events in per.items():
-        events.sort(key=lambda e: (e.get("seq", 0),
-                                   e.get("wall", 0.0)))
+    for r, attempts in per.items():
         phases = []
         by_phase: dict[str, float] = {}
-        for prev, cur in zip([None] + events, events):
-            entry = {k: cur[k] for k in
-                     ("phase", "wall", "ms_in_prev", "prev", "slot",
-                      "tick", "chunk", "tokens") if k in cur}
-            phases.append(entry)
-            if prev is None:
-                continue
-            ms = cur.get("ms_in_prev")
-            if not isinstance(ms, (int, float)):
-                w0, w1 = prev.get("wall"), cur.get("wall")
-                ms = ((w1 - w0) * 1e3
-                      if isinstance(w0, (int, float))
-                      and isinstance(w1, (int, float)) else 0.0)
-            name = cur.get("prev", prev.get("phase", "?"))
-            by_phase[name] = by_phase.get(name, 0.0) + float(ms)
+        # order attempts by index, then walk each attempt's events by
+        # its OWN seq counter; the (prev, cur) accounting below never
+        # crosses an attempt boundary
+        ordered = []
+        for att in sorted(attempts):
+            events = attempts[att]
+            events.sort(key=lambda e: (e.get("seq", 0),
+                                       e.get("wall", 0.0)))
+            ordered.append(events)
+        for events in ordered:
+            for prev, cur in zip([None] + events, events):
+                entry = {k: cur[k] for k in
+                         ("phase", "wall", "ms_in_prev", "prev", "slot",
+                          "tick", "chunk", "tokens", "attempt",
+                          "resumed", "trace") if k in cur}
+                phases.append(entry)
+                if prev is None:
+                    continue
+                ms = cur.get("ms_in_prev")
+                if not isinstance(ms, (int, float)):
+                    w0, w1 = prev.get("wall"), cur.get("wall")
+                    ms = ((w1 - w0) * 1e3
+                          if isinstance(w0, (int, float))
+                          and isinstance(w1, (int, float)) else 0.0)
+                name = cur.get("prev", prev.get("phase", "?"))
+                by_phase[name] = by_phase.get(name, 0.0) + float(ms)
         complete = bool(phases) and phases[0]["phase"] == "submit" \
             and phases[-1]["phase"] == "finished"
         e2e = None
-        if complete and isinstance(phases[0].get("wall"), (int, float)) \
+        if complete and len(ordered) == 1 \
+                and isinstance(phases[0].get("wall"), (int, float)) \
                 and isinstance(phases[-1].get("wall"), (int, float)):
+            # the single-attempt wall span; across attempts the stamps
+            # come from different processes' clocks, so the honest e2e
+            # is the stitcher's (router-clock) number, not a raw delta
             e2e = round((phases[-1]["wall"] - phases[0]["wall"]) * 1e3,
                         3)
         out[r] = {"phases": phases,
                   "by_phase_ms": {k: round(v, 3)
                                   for k, v in sorted(by_phase.items())},
                   "complete": complete,
+                  "attempts": len(ordered),
                   "e2e_ms": e2e}
+    return out
+
+
+def request_waterfall(journey: dict) -> dict | None:
+    """Reduce one stitched journey (`telemetry/tracing.build_journeys`)
+    into the per-request latency waterfall: ``rq_*_ms`` components plus
+    matching ``rq_*_frac`` fractions that sum to the measured e2e BY
+    CONSTRUCTION — ``rq_unexplained`` is the residual between the
+    named segments and the router-measured e2e, so it doubles as the
+    stitching-quality alarm (clock misfit or missing streams inflate
+    it). None when the journey has no usable e2e."""
+    from shallowspeed_tpu.telemetry.tracing import COMPONENTS
+
+    e2e = journey.get("e2e_ms")
+    if not isinstance(e2e, (int, float)) or e2e <= 0.0:
+        return None
+    comps = {name: 0.0 for name in COMPONENTS}
+    for seg in journey.get("segments") or ():
+        comps[seg["component"]] = (comps.get(seg["component"], 0.0)
+                                   + float(seg["ms"]))
+    out = {"e2e_ms": round(float(e2e), 3)}
+    named = 0.0
+    for name in COMPONENTS:
+        out[f"{name}_ms"] = round(comps[name], 3)
+        out[f"{name}_frac"] = round(comps[name] / e2e, 4)
+        named += comps[name]
+    out["rq_unexplained_ms"] = round(e2e - named, 3)
+    out["rq_unexplained_frac"] = round((e2e - named) / e2e, 4)
     return out
 
 

@@ -84,12 +84,30 @@ from pathlib import Path
 # open/half_open/closed transitions), `replica`/`failovers` on the
 # router's fleet-edge "request" records, and `resumed` on "lifecycle"
 # submit lines (a continuation re-prefilled from another engine).
+# 11 = v10 plus the distributed-tracing extension (round 16,
+# `telemetry/tracing.py`): every metrics line may carry `mono` — the
+# monotonic half of a per-process (wall, monotonic) clock pair the
+# cross-process stitcher uses to fit one offset per process stanza —
+# and the trace-context fields ride the request-path events: `trace`
+# (one id per fleet request, minted by `Router.submit` or by
+# `ServingEngine.submit` for standalone serving), `span` (this
+# process's span id for the request / dispatch attempt), `parent`
+# (the upstream span id), and `attempt` (0-based cross-engine
+# dispatch attempt — a failover re-dispatch increments it, which is
+# what lets `report.request_timeline` key its reduction on
+# (rid, attempt) instead of interleaving two attempts' seq counters).
+# "route"/"failover" events additionally carry the dispatch span they
+# minted plus the router's pre-POST `dispatch_wall`/`dispatch_mono`
+# clock pair (the stamp that happens-before the replica's lifecycle
+# "submit" — the skew fit's lower bound); "route" grows `wait_ms`
+# (router submit -> dispatch) so the stitcher can recover the
+# fleet-edge submit time.
 # The validator accepts ALL dialects — every versioned field is
-# optional, so committed v1-v9 artifacts (no version stamp / no
+# optional, so committed v1-v10 artifacts (no version stamp / no
 # health / overlap / attrib / wall / fault / request / monitor /
-# straggler / lifecycle / speculation / routing fields) keep
-# validating unchanged.
-SCHEMA_VERSION = 10
+# straggler / lifecycle / speculation / routing / tracing fields)
+# keep validating unchanged.
+SCHEMA_VERSION = 11
 
 _NUM = (int, float)
 
@@ -168,7 +186,9 @@ _REQUEST_OPTIONAL = {"tpot_ms": _NUM, "e2e_ms": _NUM, "wait_ms": _NUM,
                      "queue_depth": int, "preempted": int,
                      "spec_drafted": int, "spec_accepted": int,
                      # v10: the router's fleet-edge request records
-                     "replica": str, "failovers": int}
+                     "replica": str, "failovers": int,
+                     # v11: trace context (telemetry/tracing.py)
+                     "trace": str, "span": str, "attempt": int}
 
 # optional typed fields on a "generate" line (schema v9: the serving
 # tick fields written since v6 become typed, plus the speculation
@@ -190,11 +210,26 @@ _STRAGGLER_OPTIONAL = {"ratio": _NUM, "z": _NUM, "replica_q": _NUM,
                        "fleet_q": _NUM, "q": int, "rounds": int}
 _LIFECYCLE_OPTIONAL = {"seq": int, "slot": int, "tick": int,
                        "chunk": int, "tokens": int, "prev": str,
-                       "ms_in_prev": _NUM, "resumed": int}
+                       "ms_in_prev": _NUM, "resumed": int,
+                       # v11: trace context — one trace id per fleet
+                       # request, one span per engine attempt, parent
+                       # = the router's dispatch span, attempt = the
+                       # 0-based cross-engine dispatch counter
+                       "trace": str, "span": str, "parent": str,
+                       "attempt": int}
 
-# optional typed fields on the schema-v10 routing events
-_ROUTE_OPTIONAL = {"queue_depth": int, "score": _NUM}
-_FAILOVER_OPTIONAL = {"from": str, "tokens_done": int, "attempt": int}
+# optional typed fields on the schema-v10 routing events (trace/span/
+# parent + route wait_ms are the v11 tracing extension;
+# dispatch_wall/dispatch_mono are the router's PRE-POST clock pair —
+# the only router stamp that happens-before the replica's lifecycle
+# "submit", which the stitcher's skew fit uses as its lower bound)
+_ROUTE_OPTIONAL = {"queue_depth": int, "score": _NUM,
+                   "trace": str, "span": str, "parent": str,
+                   "wait_ms": _NUM,
+                   "dispatch_wall": _NUM, "dispatch_mono": _NUM}
+_FAILOVER_OPTIONAL = {"from": str, "tokens_done": int, "attempt": int,
+                      "trace": str, "span": str, "parent": str,
+                      "dispatch_wall": _NUM, "dispatch_mono": _NUM}
 _SCALE_OPTIONAL = {"replica": str, "reason": str, "n_replicas": int,
                    "burn": _NUM}
 
@@ -301,6 +336,9 @@ def _validate_metric(rec: dict) -> list[str]:
     # schema v4: any metrics line may carry an absolute `wall` stamp
     if "wall" in rec and not isinstance(rec["wall"], _NUM):
         probs.append("metrics: 'wall' is not numeric")
+    # schema v11: ... and the monotonic half of the clock pair
+    if "mono" in rec and not isinstance(rec["mono"], _NUM):
+        probs.append("metrics: 'mono' is not numeric")
     return probs
 
 
@@ -318,6 +356,25 @@ def _validate_span(rec: dict) -> list[str]:
     if "args" in rec and not isinstance(rec["args"], dict):
         probs.append("span: 'args' is not an object")
     return probs
+
+
+def parse_metrics_jsonl(path) -> list[dict]:
+    """Read one metrics JSONL tolerantly: skip blank lines and
+    unparseable JSON (a torn tail mid-write), keep only dicts carrying
+    an "event" key — the one line-level dialect every offline reducer
+    (goodput, the trace stitcher) consumes. Shared here so hardening
+    lands in both."""
+    out = []
+    for line in Path(path).read_text().splitlines():
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "event" in rec:
+            out.append(rec)
+    return out
 
 
 def validate_file(path) -> list[str]:

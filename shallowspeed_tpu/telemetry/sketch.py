@@ -110,6 +110,13 @@ class LogHistogram:
                 n += c
         return n
 
+    def count_le(self, x: float) -> int:
+        """Observations at or below `x`, at bucket resolution (the
+        complement of `count_above`, zeros/negatives included) — the
+        cumulative counter behind the native Prometheus histogram
+        export. Exact except for samples within `rel_err` of `x`."""
+        return self.n - self.count_above(x)
+
     def summary(self, qs=(50, 95, 99)) -> dict:
         """The /status.json block for this sketch."""
         out = {"count": self.n}

@@ -39,6 +39,24 @@ def test_append_only_across_loggers(tmp_path):
     assert events == ["run_start", "a", "run_start", "b"]
 
 
+def test_persistent_handle_survives_external_rotation(tmp_path):
+    """Round 16 kept one flushed append handle per logger (reopening
+    per line taxed the serving lifecycle stream); the per-line reopen's
+    rotation tolerance must survive: after an external mv/unlink, later
+    lines land in a fresh file at the path, not the orphaned inode."""
+    p = tmp_path / "m.jsonl"
+    m = MetricsLogger(p, kind="serve")
+    m.log(event="step", step=1)
+    (tmp_path / "m.jsonl.1").write_bytes(p.read_bytes())
+    p.unlink()          # logrotate-style: old inode moved away
+    m.log(event="step", step=2)
+    rows = read_jsonl(p)
+    assert [r.get("step") for r in rows] == [2]
+    m.close()
+    rotated = read_jsonl(tmp_path / "m.jsonl.1")
+    assert [r.get("step") for r in rotated] == [None, 1]
+
+
 def test_noop_without_path(tmp_path):
     m = MetricsLogger(None)
     m.log(event="x")

@@ -564,6 +564,164 @@ def test_tailer_restarts_after_truncation(tmp_path):
     assert len(recs) == 30 and pos > 0
 
 
+def test_tailer_torn_trailing_line_reread_whole(tmp_path):
+    """Satellite (round 16): a record torn mid-write — the tail of
+    the file ends WITHOUT a newline — must never be ingested as a
+    truncated JSON parse (which would silently skip the record); the
+    tailer leaves it unconsumed and re-reads it WHOLE once the writer
+    completes it."""
+    from shallowspeed_tpu.telemetry.monitor import iter_jsonl
+
+    path = tmp_path / "m.jsonl"
+    whole = json.dumps({"event": "request", "id": "a0",
+                        "ttft_ms": 10.0, "tokens_in": 1,
+                        "tokens_out": 1, "wall": 1.0}) + "\n"
+    torn = json.dumps({"event": "request", "id": "a1",
+                       "ttft_ms": 20.0, "tokens_in": 1,
+                       "tokens_out": 1, "wall": 2.0})
+    path.write_text(whole + torn[:len(torn) // 2])   # mid-record cut
+    mon = Monitor(flight=0, snapshot_every=0)
+    tailer = FileTailer(path, mon)
+    assert tailer.drain() == 1          # only the complete line
+    assert mon.sketches.sketches["ttft_ms"].n == 1
+    # repeated polls while the writer is stalled: still nothing —
+    # the torn fragment is NOT consumed as a failed parse
+    assert tailer.drain() == 0
+    # the writer completes the record: it arrives whole, once
+    with open(path, "a") as f:
+        f.write(torn[len(torn) // 2:] + "\n")
+    assert tailer.drain() == 1
+    sk = mon.sketches.sketches["ttft_ms"]
+    assert sk.n == 2 and sk.vmax == 20.0
+
+
+def test_tailer_rotation_mid_record(tmp_path):
+    """Satellite (round 16): a log ROTATED mid-record — the new file
+    (fresh inode) itself ends in a torn line. The inode check restarts
+    the tailer at byte 0; the new file's torn tail must behave exactly
+    like any torn tail: skipped while incomplete, ingested whole when
+    completed — never a truncated-parse record skip."""
+    import os
+
+    path = tmp_path / "m.jsonl"
+    path.write_text(json.dumps(
+        {"event": "request", "id": "old", "ttft_ms": 5.0,
+         "tokens_in": 1, "tokens_out": 1, "wall": 1.0}) + "\n")
+    mon = Monitor(flight=0, snapshot_every=0)
+    tailer = FileTailer(path, mon)
+    assert tailer.drain() == 1
+    # rotate to a LONGER file whose last record is torn mid-write
+    torn = json.dumps({"event": "request", "id": "n2",
+                       "ttft_ms": 30.0, "tokens_in": 1,
+                       "tokens_out": 1, "wall": 12.0})
+    rotated = tmp_path / "m.jsonl.new"
+    rotated.write_text("".join(
+        json.dumps({"event": "request", "id": f"n{i}", "ttft_ms": 20.0,
+                    "tokens_in": 1, "tokens_out": 1,
+                    "wall": 10.0 + i}) + "\n" for i in range(2))
+        + torn[:10])
+    os.replace(rotated, path)
+    assert tailer.drain() == 2          # complete lines only
+    assert tailer.drain() == 0          # torn tail never mis-parsed
+    with open(path, "a") as f:
+        f.write(torn[10:] + "\n")
+    assert tailer.drain() == 1          # ... re-read whole
+    assert mon.sketches.sketches["ttft_ms"].n == 4
+    assert mon.sketches.sketches["ttft_ms"].vmax == 30.0
+
+
+# --------------------------------------- native prometheus histograms
+
+
+def test_log_histogram_count_le_and_prom_buckets():
+    """Satellite (round 16): `count_le` is the cumulative counter
+    behind the native histogram export — monotone over the fixed le
+    ladder, +Inf == n, and bucket counts SUM across merged sketches
+    (the property that makes fleet histogram_quantile correct)."""
+    from shallowspeed_tpu.telemetry.monitor import (
+        HIST_LE, prom_histogram_lines)
+    from shallowspeed_tpu.telemetry.sketch import LogHistogram
+
+    a, b = LogHistogram(), LogHistogram()
+    for v in (0.0, 3.0, 40.0, 500.0, 500.0):
+        a.add(v)
+    for v in (7.0, 7.0, 9000.0):
+        b.add(v)
+    assert a.count_le(0.0) == 1          # the zero sample
+    assert a.count_le(1e9) == a.n
+    cums = [a.count_le(le) for le in HIST_LE]
+    assert cums == sorted(cums)          # monotone
+    # merged bucket counts == sum of parts at EVERY boundary
+    parts = {le: a.count_le(le) + b.count_le(le) for le in HIST_LE}
+    a.merge(b)
+    assert {le: a.count_le(le) for le in HIST_LE} == parts
+    lines = prom_histogram_lines("x_ms", a)
+    assert lines[0] == "# TYPE x_ms_hist histogram"
+    assert f'x_ms_hist_bucket{{le="+Inf"}} {a.n}' in lines
+    assert f"x_ms_hist_count {a.n}" in lines
+    assert any(line.startswith("x_ms_hist_sum ") for line in lines)
+    # labelled form (the fleet export): label spliced before le, one
+    # TYPE line suppressible for series after the first
+    lab = prom_histogram_lines("x_ms", a, label='replica="r0",',
+                               type_line=False)
+    assert all(not line.startswith("# TYPE") for line in lab)
+    assert any('x_ms_hist_bucket{replica="r0",le="+Inf"}' in line
+               for line in lab)
+
+
+def test_monitor_metrics_exports_native_histogram():
+    mon = _mk_monitor()
+    for i in range(10):
+        mon.note_line({"event": "request", "id": f"r{i}",
+                       "ttft_ms": 40.0 + i, "tokens_in": 1,
+                       "tokens_out": 1, "wall": float(i)})
+    prom = mon.prometheus()
+    # summary with quantile labels STILL there...
+    assert 'shallowspeed_ttft_ms{quantile="0.95"}' in prom
+    # ... and the native histogram alongside
+    assert "# TYPE shallowspeed_ttft_ms_hist histogram" in prom
+    assert 'shallowspeed_ttft_ms_hist_bucket{le="+Inf"} 10' in prom
+    assert 'shallowspeed_ttft_ms_hist_bucket{le="25"} 0' in prom
+    assert 'shallowspeed_ttft_ms_hist_bucket{le="50"} ' in prom
+    assert "shallowspeed_ttft_ms_hist_count 10" in prom
+
+
+def test_fleet_metrics_histograms_aggregate_across_replicas(tmp_path):
+    """Two replicas' native histograms share the fixed le ladder with
+    replica labels, so a Prometheus sum() over them equals the pooled
+    distribution — the aggregation summaries cannot provide."""
+    from shallowspeed_tpu.telemetry.fleet import FleetCollector
+
+    paths = []
+    for name, ttft in (("ra", 40.0), ("rb", 400.0)):
+        p = tmp_path / f"{name}.jsonl"
+        p.write_text("".join(
+            json.dumps({"event": "run_start", "replica": name,
+                        "wall": 1.0}) + "\n"
+            + json.dumps({"event": "request", "id": f"{name}-{i}",
+                          "ttft_ms": ttft, "tokens_in": 1,
+                          "tokens_out": 1, "wall": 2.0 + i}) + "\n"
+            for i in range(4)))
+        paths.append(p)
+    fc = FleetCollector(paths=paths)
+    fc.refresh()
+    prom = fc.prometheus()
+    assert prom.count("# TYPE shallowspeed_ttft_ms_hist histogram") \
+        == 1
+    assert 'shallowspeed_ttft_ms_hist_bucket{replica="ra",' \
+           'le="+Inf"} 4' in prom
+    assert 'shallowspeed_ttft_ms_hist_bucket{replica="rb",' \
+           'le="+Inf"} 4' in prom
+    # the per-le sums across replicas ARE the pooled cumulative
+    # counts: at le=50 only ra's 4 samples, at le=500 all 8
+    assert 'shallowspeed_ttft_ms_hist_bucket{replica="ra",le="50"} 4' \
+        in prom
+    assert 'shallowspeed_ttft_ms_hist_bucket{replica="rb",le="50"} 0' \
+        in prom
+    assert 'shallowspeed_ttft_ms_hist_bucket{replica="rb",le="500"} 4' \
+        in prom
+
+
 def test_schema_v8_straggler_and_lifecycle_lines():
     from shallowspeed_tpu.telemetry import schema
 

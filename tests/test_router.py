@@ -658,3 +658,29 @@ def test_fleet_chaos_drill_cross_process(tmp_path):
     assert fl["fleet_availability"] >= 0.5
     out = format_report(rep)
     assert "fleet availability" in out
+    # round 16: the drill's logs stitch into ONE skew-corrected trace
+    # — a failed-over request's spans from the router and BOTH
+    # replicas on a single ordered timeline, its waterfall closing
+    # within 5% of the measured e2e (telemetry/tracing.py; the full
+    # acceptance canary is tests/test_tracing.py)
+    from shallowspeed_tpu.telemetry import tracing
+    from shallowspeed_tpu.telemetry.report import request_waterfall
+
+    replica_logs = [tmp_path / f"rep_{n}.jsonl"
+                    for n in ("r0", "r1", "r2")]
+    st = tracing.stitch([log] + replica_logs)
+    fos = [e for e in router.events if e["event"] == "failover"]
+    spanning = [st["journeys"][e["trace"]] for e in fos
+                if len(st["journeys"][e["trace"]]["sources"]) >= 3]
+    assert spanning, [st["journeys"][e["trace"]]["sources"]
+                      for e in fos]
+    for jn in spanning:
+        t_att = {att: [t for t, _p, _r in evs]
+                 for att, evs in jn["attempts"].items()}
+        atts = sorted(t_att)
+        for a, b in zip(atts, atts[1:]):
+            assert max(t_att[a]) <= min(t_att[b]) + 1e-6
+        wf = request_waterfall(jn)
+        assert wf is not None
+        assert abs(wf["rq_unexplained_frac"]) <= 0.05, (jn["rid"], wf)
+        assert wf["rq_failover_gap_ms"] > 0.0
