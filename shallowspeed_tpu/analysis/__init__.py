@@ -13,11 +13,30 @@ statically proves each compiled step is TPU-clean —
 - one executable per entrypoint for the test suite's shape set
   (``retrace``),
 - static live-buffer high-water inside the HBM budget
-  (``memory-highwater``).
+  (``memory-highwater``),
+
+and — via the precision-flow prover (`provenance.flow_entrypoint`, an
+abstract interpretation of the jaxpr tracking per-value storage dtype,
+rounding history, quantization-scale identity, and absmax intervals) —
+
+- no value rounded twice without an intervening rescale
+  (``fp8-double-rounding``),
+- every dot_general and scan-carried accumulator provably accumulates
+  at the widest participating dtype (``accumulation-dtype``),
+- no gradient-sized cross-replica reduction at bf16/fp8
+  (``reduction-precision``),
+- every quantized tensor consumed together with its scale, exactly
+  once, applied on the accumulator — including the transpose/VJP side
+  (``scale-consistency``),
+- interval propagation proves exp/log/softmax/rsqrt inputs and
+  narrowing converts in range (``range-safety``).
 
 Intentional deviations are suppressed INLINE at the code that causes
 them (`findings.suppress`, mandatory reason string), so the analyzer's
-report doubles as documentation of every deliberate exception.
+report doubles as documentation of every deliberate exception — and
+`analyze` audits the registry each run: a suppression that no longer
+matches any finding becomes a MEDIUM ``stale-suppression`` finding so
+dead registrations cannot linger and swallow future regressions.
 
 Usage:
     python -m shallowspeed_tpu.analysis --target all        # CLI gate
@@ -38,7 +57,9 @@ from __future__ import annotations
 # unchanged.
 from shallowspeed_tpu.analysis.findings import (Finding, Severity,  # noqa: F401
                                                 apply_suppressions,
-                                                gate_count, suppress)
+                                                gate_count,
+                                                stale_suppressions,
+                                                suppress)
 
 _EXPORTS = {
     "RULES": "shallowspeed_tpu.analysis.rules",
@@ -52,11 +73,13 @@ _EXPORTS = {
     "aval_bytes": "shallowspeed_tpu.analysis.walker",
     "iter_eqns": "shallowspeed_tpu.analysis.walker",
     "peak_bytes": "shallowspeed_tpu.analysis.walker",
+    "FlowResult": "shallowspeed_tpu.analysis.provenance",
+    "flow_entrypoint": "shallowspeed_tpu.analysis.provenance",
 }
 
 __all__ = sorted((
     "Finding", "Severity", "suppress", "apply_suppressions",
-    "gate_count", "analyze", *_EXPORTS))
+    "gate_count", "stale_suppressions", "analyze", *_EXPORTS))
 
 
 def __getattr__(name):  # PEP 562 lazy re-exports (jax-heavy modules)
@@ -76,11 +99,15 @@ def __dir__():
 
 
 def analyze(target: str = "all", budget: int | None = None,
-            only: tuple = ()) -> dict:
+            only: tuple = (), audit: bool = True) -> dict:
     """Build and lint `target` (a probe name or group alias). Returns
     {probe name: [Finding, ...]}; `gate_count` over the concatenation
-    is the CI gate."""
-    from shallowspeed_tpu.analysis.rules import run_rules
+    is the CI gate. With `audit` (the default), registered suppressions
+    that matched nothing in this run are reported as MEDIUM
+    ``stale-suppression`` findings on the probe their glob matches —
+    only on a FULL sweep with the full rule set (a suppression can't be
+    proven stale when the probe or rule it covers didn't run)."""
+    from shallowspeed_tpu.analysis.rules import RULES, run_rules
     from shallowspeed_tpu.analysis.targets import (DEFAULT_BUDGET,
                                                    TARGET_BUILDERS,
                                                    resolve_targets)
@@ -89,4 +116,7 @@ def analyze(target: str = "all", budget: int | None = None,
     for name in resolve_targets(target):
         probe = TARGET_BUILDERS[name](budget=budget or DEFAULT_BUDGET)
         out[probe.name] = run_rules(probe, only=only)
+    if audit and not only and set(out) >= set(TARGET_BUILDERS):
+        for f in stale_suppressions(out, ran_rules=tuple(RULES)):
+            out[f.target].append(f)
     return out

@@ -2,17 +2,55 @@
 
 Builds each requested target's engines at the test-suite configuration,
 runs every rule, prints the findings (suppressed ones with their
-reasons — the intentional-deviation documentation), and exits non-zero
-iff any unsuppressed HIGH finding remains. Wired as a pre-commit hook
+reasons — the intentional-deviation documentation), and gates on
+unsuppressed HIGH findings. Wired as a pre-commit hook
 (`.pre-commit-config.yaml`) and enforced in tier-1 by
 `tests/test_analysis.py`.
+
+Exit-code contract (stable — scripts may rely on it):
+
+    0   clean: no unsuppressed HIGH finding (with ``--baseline``: none
+        beyond the recorded baseline)
+    1   gate failure: at least one (new) unsuppressed HIGH finding
+    2   usage error: unknown target, unknown rule, unreadable/invalid
+        baseline file, bad flags (argparse's own convention)
+
+``--format json`` emits one machine-readable document on stdout
+(schema ``shallowspeed-tpu.analysis/1``):
+
+    {"schema": ..., "gate": <int>, "baselined": <int>,
+     "targets": {<probe>: {"findings": [<Finding.to_dict()>, ...],
+                           "gating": <int>}},
+     "summary": {"targets": n, "findings": n, "gating": n,
+                 "suppressed": n}}
+
+``--write-baseline FILE`` records every current gating finding's stable
+key; a later run with ``--baseline FILE`` gates only on findings whose
+key is NOT recorded — the ratchet mode for adopting a new rule on a
+codebase with known, not-yet-fixed violations. Baselined findings are
+still printed (marked ``baselined``); fixing them shrinks the file on
+the next ``--write-baseline``.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+
+SCHEMA = "shallowspeed-tpu.analysis/1"
+
+
+def _load_baseline(path, ap) -> set:
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        keys = doc["keys"]
+        assert isinstance(keys, list)
+    except (OSError, ValueError, KeyError, AssertionError) as e:
+        ap.error(f"cannot read baseline {path!r}: {e}")  # exits 2
+    return set(keys)
 
 
 def main(argv=None) -> int:
@@ -20,16 +58,29 @@ def main(argv=None) -> int:
         prog="python -m shallowspeed_tpu.analysis",
         description="statically prove the compiled train steps are "
                     "TPU-clean (dtype / donation / collectives / "
-                    "retrace / memory)")
+                    "retrace / memory / precision flow)",
+        epilog="exit codes: 0 clean, 1 gating finding(s), 2 usage "
+               "error")
     ap.add_argument("--target", default="all",
                     help="probe or group: engine, spmd_pipeline, gspmd, "
                          "pipeline_lm, zb, all, or an exact probe name "
-                         "like pipeline_lm:1f1b (default: all)")
+                         "like pipeline_lm:1f1b or fp8_train "
+                         "(default: all)")
     ap.add_argument("--budget-gb", type=float, default=16.0,
                     help="HBM budget for the memory-highwater rule "
                          "(default: 16 GiB — one v4/v5e-class chip)")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule subset (default: all)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text",
+                    help="output format (json: one document on stdout, "
+                         "schema %s)" % SCHEMA)
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="gate only on findings whose key is not in "
+                         "this baseline file")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="record current gating findings' keys to FILE "
+                         "and exit 0")
     ap.add_argument("--platform", default=os.environ.get(
         "JAX_PLATFORMS", "cpu"),
         help="jax platform (default: cpu — the pass is static; probes "
@@ -37,6 +88,9 @@ def main(argv=None) -> int:
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="print only gating findings and the summary")
     args = ap.parse_args(argv)
+
+    baseline = (_load_baseline(args.baseline, ap)
+                if args.baseline else None)
 
     if args.platform == "cpu":
         flags = os.environ.get("XLA_FLAGS", "")
@@ -49,32 +103,75 @@ def main(argv=None) -> int:
     jax.config.update("jax_platforms", args.platform)
 
     from shallowspeed_tpu.analysis import (RULES, Severity, analyze,
-                                           gate_count)
+                                           resolve_targets)
 
     only = tuple(r for r in args.rules.split(",") if r)
     unknown = [r for r in only if r not in RULES]
     if unknown:  # a typo must not silently run zero rules and exit 0
-        raise SystemExit(
-            f"unknown rule(s) {unknown}; pick from {sorted(RULES)}")
+        ap.error(f"unknown rule(s) {unknown}; "
+                 f"pick from {sorted(RULES)}")
+    try:  # unknown target is a usage error too (exit 2, not 1)
+        resolve_targets(args.target)
+    except SystemExit as e:
+        ap.error(str(e))
     budget = int(args.budget_gb * (1 << 30))
     results = analyze(args.target, budget=budget, only=only)
 
-    total = []
-    for name, findings in results.items():
-        total.extend(findings)
-        shown = [f for f in findings
-                 if not args.quiet or (f.severity == Severity.HIGH
-                                       and not f.suppressed)]
-        print(f"== {name}: {len(findings)} finding(s), "
-              f"{gate_count(findings)} gating")
-        for f in shown:
-            print("  " + f.format().replace("\n", "\n  "))
-    n_gate = gate_count(total)
+    def gates(f):  # unsuppressed HIGH, beyond the baseline if any
+        return (f.severity == Severity.HIGH and not f.suppressed
+                and (baseline is None or f.key not in baseline))
+
+    total = [f for fs in results.values() for f in fs]
+    gating = [f for f in total if gates(f)]
+    n_base = sum(1 for f in total
+                 if f.severity == Severity.HIGH and not f.suppressed
+                 and not gates(f))
     n_sup = sum(1 for f in total if f.suppressed)
+
+    if args.write_baseline:
+        keys = sorted({f.key for f in total
+                       if f.severity == Severity.HIGH
+                       and not f.suppressed})
+        with open(args.write_baseline, "w") as fh:
+            json.dump({"schema": SCHEMA, "keys": keys}, fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {len(keys)} baseline key(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.format == "json":
+        doc = {
+            "schema": SCHEMA,
+            "gate": len(gating),
+            "baselined": n_base,
+            "targets": {
+                name: {"findings": [f.to_dict() for f in fs],
+                       "gating": sum(1 for f in fs if gates(f))}
+                for name, fs in results.items()},
+            "summary": {"targets": len(results),
+                        "findings": len(total),
+                        "gating": len(gating),
+                        "suppressed": n_sup},
+        }
+        json.dump(doc, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 1 if gating else 0
+
+    for name, findings in results.items():
+        shown = [f for f in findings if not args.quiet or gates(f)]
+        print(f"== {name}: {len(findings)} finding(s), "
+              f"{sum(1 for f in findings if gates(f))} gating")
+        for f in shown:
+            line = "  " + f.format().replace("\n", "\n  ")
+            if (baseline is not None and f.severity == Severity.HIGH
+                    and not f.suppressed and not gates(f)):
+                line += "\n    (baselined)"
+            print(line)
     print(f"\n{len(results)} target(s), {len(total)} finding(s): "
-          f"{n_gate} gating high-severity, {n_sup} suppressed "
-          f"(documented above)")
-    return 1 if n_gate else 0
+          f"{len(gating)} gating high-severity, {n_sup} suppressed "
+          f"(documented above)"
+          + (f", {n_base} baselined" if baseline is not None else ""))
+    return 1 if gating else 0
 
 
 if __name__ == "__main__":

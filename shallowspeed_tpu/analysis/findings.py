@@ -19,7 +19,8 @@ import it at module scope without dragging jax tracing machinery in.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass
+import re
+from dataclasses import dataclass, field
 from fnmatch import fnmatch
 
 
@@ -42,12 +43,39 @@ class Finding:
     path: tuple = ()             # enclosing sub-jaxpr chain (prim names)
     message: str = ""
     suppressed: str | None = None  # reason string when suppressed
+    # the registration that suppressed it (stale-suppression audit);
+    # never serialized — `suppressed` carries the reason
+    suppressed_by: object = field(default=None, repr=False,
+                                  compare=False)
 
     @property
     def where(self) -> str:
         chain = "/".join(self.path)
         return f"{self.target}::{self.site}" + (f" [{chain}]" if chain
                                                 else "")
+
+    @property
+    def key(self) -> str:
+        """Stable identity for baseline diffing: location + message with
+        the volatile dedup count (` (xN)`) stripped."""
+        msg = re.sub(r" \(x\d+\)$", "", self.message)
+        return "|".join((self.rule, self.target, self.site,
+                         "/".join(self.path), msg))
+
+    def to_dict(self) -> dict:
+        """Machine-readable form (--format json). Stable fields: rule,
+        severity (name), target, site, path (list), message,
+        suppressed (reason or null), key."""
+        return {
+            "rule": self.rule,
+            "severity": self.severity.name,
+            "target": self.target,
+            "site": self.site,
+            "path": list(self.path),
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "key": self.key,
+        }
 
     def format(self) -> str:
         tag = ("suppressed"
@@ -107,8 +135,39 @@ def apply_suppressions(findings: list) -> list:
             if s.match and s.match not in hay:
                 continue
             f.suppressed = s.reason
+            f.suppressed_by = s
             break
     return findings
+
+
+def stale_suppressions(results: dict, ran_rules=()) -> list:
+    """The registry anti-rot audit: a registered suppression whose rule
+    ran against a probe its target glob matches, yet matched NO finding,
+    is itself a MEDIUM finding — the deviation it documented no longer
+    exists and the registration must be deleted (or it will silently
+    swallow a future regression). `results` is analyze()'s
+    {probe: [Finding]} map AFTER apply_suppressions; `ran_rules` the
+    rule names that actually ran (empty = audit every registration)."""
+    used = {id(f.suppressed_by) for fs in results.values() for f in fs
+            if f.suppressed_by is not None}
+    out = []
+    for s in _REGISTRY:
+        if id(s) in used:
+            continue
+        if ran_rules and s.rule != "*" and s.rule not in ran_rules:
+            continue  # its rule didn't run — nothing proven stale
+        probes = [p for p in results if fnmatch(p, s.target)]
+        if not probes:
+            continue  # its target wasn't analyzed
+        out.append(Finding(
+            "stale-suppression", Severity.MEDIUM, probes[0],
+            "(suppression registry)", (),
+            f"suppression (rule={s.rule!r}, target={s.target!r}, "
+            f"match={s.match!r}) matched no finding in this run — the "
+            f"deviation it documented is gone; delete the registration "
+            f"before it swallows a future regression (its reason was: "
+            f"{s.reason})"))
+    return out
 
 
 def gate_count(findings: list) -> int:

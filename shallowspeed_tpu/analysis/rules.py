@@ -1,4 +1,4 @@
-"""The rule registry and the five shipped lint rules.
+"""The rule registry and the shipped lint rules.
 
 Each rule is a pure function `(probe) -> list[Finding]` over a
 `TargetProbe` (`targets.py`): the probe holds the real train-step
@@ -6,7 +6,10 @@ entrypoints, their traced jaxprs, the constructing mesh, and the
 declared compute dtype. Rules never execute device code except where
 the check IS behavioral (the retrace audit reads compilation-cache
 sizes after the probe exercised each entrypoint with the test suite's
-shape/dtype set).
+shape/dtype set). The precision rules additionally share ONE
+abstract-interpretation pass per entrypoint (`provenance.py`, cached
+by `TargetProbe.flow`) carrying per-value dtype/rounding/scale/range
+provenance.
 
 Shipped rules:
 
@@ -24,6 +27,14 @@ Shipped rules:
   ran the test-suite shape/dtype set through it (retrace storms).
 - ``memory-highwater`` static live-buffer byte estimate per entrypoint
   jaxpr vs the probe's HBM budget.
+- ``overlap-bucket``   registered-overlap programs: every grad-sized
+  dp reduction is a planned bucket with compute in its scope.
+- ``dequant-fusion``   quantized weights dequantize INTO the matmul,
+  never into a materialized full-size buffer.
+- ``fp8-double-rounding`` / ``accumulation-dtype`` /
+  ``reduction-precision`` / ``scale-consistency`` / ``range-safety``
+  — the precision-flow prover (see each rule's docstring): statically
+  certifies the quantized training step's numerics.
 """
 
 from __future__ import annotations
@@ -606,6 +617,226 @@ def dequant_fusion(probe) -> list:
                         f"a materialized dequantized copy; apply the "
                         f"scale to the f32 accumulator instead "
                         f"(ops.matmul.dequant_matmul)"))
+    return out
+
+
+# --------------------------------------------- precision-flow rules
+#
+# The five quantized-training rules ride ONE shared abstract-
+# interpretation pass (`provenance.py`, cached per entrypoint by
+# `TargetProbe.flow`): per-value storage-dtype lineage, rounding
+# state, quantization-scale pairing, and calibration-seeded absmax
+# intervals. They are the static gate for ROADMAP item 5 — the
+# fp8_train probe must come out clean before a long quantized run is
+# worth burning.
+
+
+@rule("fp8-double-rounding")
+def fp8_double_rounding(probe) -> list:
+    """A value that crossed one narrowing float convert and crosses a
+    SECOND — to a strictly narrower format, or back into quantized
+    storage — without an intervening rescale (f32->bf16->fp8, or fp8
+    re-quantized straight). Stacking roundings of decreasing width
+    compounds error beyond the target format's half-ulp and is never
+    intended — correct requantization rescales (divides by a fresh
+    scale) first, which resets the rounding state. Re-rounding at the
+    SAME width (bf16 -> f32 arithmetic -> bf16, the standard mixed-
+    precision pattern) is one rounding of a new value and is exempt."""
+    out = []
+    for ep in probe.entrypoints:
+        for ev in probe.flow(ep).events:
+            if ev.kind != "double-round":
+                continue
+            d = ev.data
+            out.append(Finding(
+                "fp8-double-rounding", Severity.HIGH, probe.name,
+                ep.name, ev.path,
+                f"value already rounded to {d['first']} is rounded "
+                f"again to {d['dst']} (shape {d['shape']}) with no "
+                f"intervening rescale — double rounding compounds "
+                f"quantization error; rescale (x / s) before the "
+                f"second convert"))
+    return out
+
+
+@rule("accumulation-dtype")
+def accumulation_dtype(probe) -> list:
+    """Every contraction and loop-carried sum must prove widest-type
+    accumulation:
+
+    - a dot_general with QUANTIZED-lineage operands (int8/fp8 storage,
+      however upcast) must emit f32 (`preferred_element_type`) — the
+      whole point of quantized storage is 1-byte reads into a wide
+      accumulator, and a narrow output rounds K products away (HIGH);
+    - a scan/while carry that is an accumulator (carry + independent
+      contribution per iteration) must carry f32 — the peeled-
+      microbatch grad sums re-round every add otherwise (HIGH);
+    - a plain narrow-float dot with a narrow output is informational
+      (LOW): the MXU accumulates f32 internally and rounds once at the
+      output, which is the documented activation-path numerics, but
+      long contractions feeding accumulators deserve an explicit
+      `preferred_element_type=f32`."""
+    out = []
+    wide = ("float32", "float64")
+    for ep in probe.entrypoints:
+        flow = probe.flow(ep)
+        for ev in flow.events:
+            if ev.kind == "carry-accum":
+                d = ev.data
+                out.append(Finding(
+                    "accumulation-dtype", Severity.HIGH, probe.name,
+                    ep.name, ev.path,
+                    f"{d['prim']}-carried accumulator (shape "
+                    f"{d['shape']}) accumulates in {d['dtype']} — "
+                    f"every iteration re-rounds the running sum; "
+                    f"carry f32 and cast once at the end"))
+            if ev.kind != "dot":
+                continue
+            d = ev.data
+            odt = d["out_dtype"]
+            if odt in wide or odt is None:
+                continue
+            floats = [t for t in d["in_dtypes"]
+                      if t and (t.startswith("float")
+                                or t.startswith("bfloat"))]
+            if not floats:
+                continue
+            if d["quant"]:
+                out.append(Finding(
+                    "accumulation-dtype", Severity.HIGH, probe.name,
+                    ep.name, ev.path,
+                    f"dot_general over quantized-storage operands "
+                    f"{d['in_dtypes']} emits {odt} (K={d['k']}) — "
+                    f"quantized matmuls must accumulate f32 "
+                    f"(preferred_element_type) with the scale applied "
+                    f"to the accumulator"))
+            elif all(t not in wide for t in floats):
+                out.append(Finding(
+                    "accumulation-dtype", Severity.LOW, probe.name,
+                    ep.name, ev.path,
+                    f"narrow dot_general {d['in_dtypes']}->{odt}: "
+                    f"MXU accumulates f32 internally and rounds once "
+                    f"at the output (standard activation numerics); "
+                    f"prefer preferred_element_type=f32 where the "
+                    f"result feeds an accumulator"))
+    return out
+
+
+# collectives that REDUCE (sum) across devices — the precision-
+# sensitive subset of _COLLECTIVES (gather/permute move bits verbatim)
+_REDUCE_COLLECTIVES = ("psum", "psum_scatter", "reduce_scatter")
+
+
+@rule("reduction-precision")
+def reduction_precision(probe) -> list:
+    """Grad-sized cross-device reductions must run in f32: a bf16/fp8
+    `psum` rounds at every hop of the reduction tree, and a gradient
+    reduced wrong is unrecoverable after the optimizer step. Operands
+    whose chain proves f32 (the repo's grads — cast transposes emit
+    f32 cotangents) pass by construction since the operand DTYPE is
+    f32. Sub-KiB reductions (health-pack statistics, loss means) are
+    exempt, matching the `overlap-bucket` rule's threshold."""
+    out = []
+    for ep in probe.entrypoints:
+        for eqn, path, env in probe.walk(ep):
+            name = eqn.primitive.name
+            if name not in _REDUCE_COLLECTIVES:
+                continue
+            for v in eqn.invars:
+                if isinstance(v, jax.core.Literal):
+                    continue
+                dt = getattr(v.aval, "dtype", None)
+                if dt is None or not jax.numpy.issubdtype(
+                        dt, jax.numpy.floating):
+                    continue
+                if np.dtype(dt).itemsize >= 4:
+                    continue
+                nbytes = aval_bytes(v.aval)
+                if nbytes < 1024:
+                    continue  # scalar statistics, not gradient payload
+                key = _COLLECTIVES.get(name)
+                axes = _axis_names(eqn.params.get(key)) if key else ()
+                out.append(Finding(
+                    "reduction-precision", Severity.HIGH, probe.name,
+                    ep.name, path,
+                    f"{name} over {axes or '?'} reduces a "
+                    f"{np.dtype(dt)} operand of {nbytes} B — every "
+                    f"hop of the reduction tree re-rounds; upcast the "
+                    f"operand to f32 (or prove the chain f32) before "
+                    f"grad-sized cross-device sums"))
+    return out
+
+
+@rule("scale-consistency")
+def scale_consistency(probe) -> list:
+    """Every quantized leaf consumed by a matmul must see its paired
+    scale EXACTLY once, applied to the accumulator (or riding the
+    cotangent on the transpose/VJP side). A forgotten scale silently
+    mis-scales activations or gradients by orders of magnitude; a
+    doubled one squares it. Pairing comes from the param layout
+    (Wq/Ws dicts) or from in-program quantization (x/s followed by a
+    narrowing convert to quantized storage)."""
+    out = []
+    for ep in probe.entrypoints:
+        flow = probe.flow(ep)
+        for use in flow.dot_uses:
+            if use.resolved:
+                continue
+            out.append(Finding(
+                "scale-consistency", Severity.HIGH, probe.name,
+                ep.name, use.path,
+                f"quantized leaf {use.label} (shape {use.shape}) is "
+                f"consumed by a dot_general but its scale is never "
+                f"applied to the result — the output is mis-scaled "
+                f"by the quantization factor (forgotten Ws / "
+                f"delayed-scaling factor)"))
+        for ev in flow.events:
+            if ev.kind != "double-scale":
+                continue
+            out.append(Finding(
+                "scale-consistency", Severity.HIGH, probe.name,
+                ep.name, ev.path,
+                f"quantization scale of {ev.data.get('labels')} is "
+                f"applied TWICE on the same value lineage — the "
+                f"output is scaled by the square of the factor"))
+    return out
+
+
+@rule("range-safety")
+def range_safety(probe) -> list:
+    """Interval propagation over the calibration-seeded bounds: fires
+    only on PROVABLE dtype-range violations — an exp whose input's
+    lower bound already overflows the storage dtype, a narrowing
+    convert whose operand provably exceeds the target's max (e.g. f32
+    values in [0, 1000] cast to e4m3 with max 448 and no saturating
+    clamp), or a log/rsqrt over a provably non-positive range. The
+    pass understands the softmax shift (x - max(x) <= 0) and
+    saturation clamps, so the standard guarded patterns stay clean."""
+    out = []
+    for ep in probe.entrypoints:
+        for ev in probe.flow(ep).events:
+            if ev.kind != "range":
+                continue
+            d = ev.data
+            lo, hi = d["itv"]
+            itv = f"[{lo:.3g}, {hi:.3g}]"
+            if d["problem"] == "overflow":
+                msg = (f"{d['op']} with provable input range {itv} "
+                       f"overflows {d['dst']} (max "
+                       f"{d['bound']:.3g}) — saturate (clamp) or "
+                       f"rescale before the narrowing")
+            elif d["problem"] == "underflow":
+                msg = (f"{d['op']} with provable input range {itv} "
+                       f"underflows {d['dst']} entirely (min normal "
+                       f"{d['bound']:.3g}) — the result is all "
+                       f"zeros/denormals")
+            else:
+                msg = (f"{d['op']} over a provably non-positive "
+                       f"range {itv} — the result is NaN/inf for "
+                       f"the whole array; add the guard epsilon")
+            out.append(Finding(
+                "range-safety", Severity.HIGH, probe.name, ep.name,
+                ev.path, msg))
     return out
 
 

@@ -45,6 +45,9 @@ class EntryPoint:
     calls: int = 0                # public-API calls the probe ran
     n_compiles_expected: int = 1
     observed_compiles: int | None = None  # _cache_size after exercising
+    # arg name -> (lo, hi): measured init/calibration absmax bounds
+    # seeding the precision-flow pass's interval propagation
+    ranges: dict | None = None
 
 
 @dataclass
@@ -55,6 +58,7 @@ class TargetProbe:
     entrypoints: list = field(default_factory=list)
     hbm_budget: int = DEFAULT_BUDGET
     _jaxprs: dict = field(default_factory=dict)
+    _flows: dict = field(default_factory=dict)
 
     # ---------------------------------------------------- jaxpr access
 
@@ -87,6 +91,17 @@ class TargetProbe:
                     yield from rec(sub, path + (eqn.primitive.name,))
 
         yield from rec(top.jaxpr, ())
+
+    def flow(self, ep: EntryPoint):
+        """The entrypoint's precision-flow result (`provenance.py`),
+        computed once and shared by every rule that reads per-value
+        provenance (double-rounding, accumulation, scale pairing,
+        range safety)."""
+        if ep.name not in self._flows:
+            from shallowspeed_tpu.analysis.provenance import \
+                flow_entrypoint
+            self._flows[ep.name] = flow_entrypoint(self, ep)
+        return self._flows[ep.name]
 
     def top_pjit(self, ep: EntryPoint):
         """The outermost pjit eqn (donation lives there), or None."""
@@ -325,6 +340,68 @@ def build_pipeline_lm(schedule: str = "gpipe", virtual_pp: int = 1,
     return probe.seal()
 
 
+# ----------------------------------------------------- fp8 training probe
+
+
+def build_fp8_train(budget: int = DEFAULT_BUDGET) -> TargetProbe:
+    """`fp8.Fp8TrainEngine` — the fp8-e4m3 forward-matmul training step
+    (ROADMAP item 5). The precision-flow rules' primary target: the
+    traced step must prove in-program quantization paired to its scale
+    on BOTH dot sides (forward and the hand STE VJP), f32 accumulation,
+    in-range converts (the saturating clip), and no compounding
+    rounding. `ranges` carries measured calibration stats from the live
+    warmup steps, seeding the interval pass."""
+    import jax.numpy as jnp  # noqa: F401  (symmetry with other builders)
+
+    from shallowspeed_tpu.fp8 import Fp8TrainEngine
+    from shallowspeed_tpu.optim import MomentumSGD
+
+    sizes, bs = [12, 16, 10], 8
+    eng = Fp8TrainEngine(sizes, MomentumSGD(0.05, momentum=0.9), seed=0)
+    rng = np.random.default_rng(0)
+
+    def batch(i):
+        x = rng.standard_normal((bs, sizes[0])).astype(np.float32)
+        y = np.eye(sizes[-1], dtype=np.float32)[
+            rng.integers(0, sizes[-1], bs)]
+        return x, y
+
+    for i in range(2):
+        eng.train_batch(*batch(i))
+    xe, ye = batch(7)
+    eng.eval_loss(xe, ye)
+    eng.eval_loss(xe, ye)
+
+    # calibration: measured post-warmup absmax bounds seed the interval
+    # propagation (params drift during training — these are the stats
+    # the certificate is conditioned on, same contract as the scales)
+    pmax = max(float(np.max(np.abs(l))) for l in
+               jax.tree_util.tree_leaves(eng.params)) * 4.0
+    hist = np.asarray(eng.amax_hist)
+    ranges = {
+        "params": (-pmax, pmax),
+        "x": (-6.0, 6.0),            # standard-normal features, 6 sigma
+        "y": (0.0, 1.0),             # one-hot targets
+        "amax_hist": (float(hist.min()) / 4.0, float(hist.max()) * 4.0),
+    }
+
+    probe = TargetProbe("fp8_train", None, None, hbm_budget=budget)
+    x_sds = jax.ShapeDtypeStruct((bs, sizes[0]), np.float32)
+    y_sds = jax.ShapeDtypeStruct((bs, sizes[-1]), np.float32)
+    probe.entrypoints = [
+        EntryPoint("_step", eng._step_fn,
+                   (_sds(eng.params), _sds(eng.opt_state),
+                    _sds(eng.amax_hist), x_sds, y_sds),
+                   ("params", "opt_state", "amax_hist", "x", "y"),
+                   donate=(0, 1, 2), calls=2, ranges=ranges),
+        EntryPoint("_loss", eng._loss_fn,
+                   (_sds(eng.params), _sds(eng.amax_hist), x_sds, y_sds),
+                   ("params", "amax_hist", "x", "y"), calls=2,
+                   ranges=ranges),
+    ]
+    return probe.seal()
+
+
 # ------------------------------------------------------- serving probe
 
 
@@ -388,6 +465,7 @@ TARGET_BUILDERS: dict[str, Callable] = {
     "pipeline_lm:zb": lambda budget=DEFAULT_BUDGET:
         build_pipeline_lm("zb", compute_dtype=None, budget=budget),
     "serving": build_serving_decode,
+    "fp8_train": build_fp8_train,
 }
 
 # CLI aliases: family names expand to their member probes

@@ -1,0 +1,134 @@
+"""fp8-e4m3 forward-matmul training — ROADMAP item 5's first rung.
+
+A deliberately minimal trainer whose every numerics decision is the
+one the static prover certifies (`analysis --target fp8_train`):
+
+- **forward** matmuls run in fp8-e4m3 via `ops.matmul.fp8_dense` —
+  activations quantized with a DELAYED per-tensor scale, weights with a
+  just-in-time per-out-channel scale, dequant fused onto the f32
+  accumulator (the `dequant_matmul` discipline, extended to training).
+- **delayed scaling** (the Transformer-Engine recipe): this step's
+  activation absmaxes only feed the NEXT steps' scales, through a
+  rolling per-layer amax history carried in the step like optimizer
+  state. The history rides the health pack (`fp8_amax` / `fp8_scale`)
+  so a drifting scale is visible at every log point, next to grad
+  norms.
+- **backward** is a hand straight-through VJP: gradients stay f32
+  end-to-end (autodiff through the quantization casts would re-round
+  cotangents through e4m3 — the exact `fp8-double-rounding` bug
+  class), and parameters/optimizer state are f32 master copies.
+
+The runtime acceptance for longer runs is the PR-5 `attrib_mxu_frac`
+waterfall plus oracle loss-parity; what lives here is the statically
+certified step: the analysis gate proves no double rounding, f32
+accumulation everywhere, scale pairing on both dot sides (including
+the VJP), and in-range converts, before a long run is burned.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shallowspeed_tpu.ops.matmul import E4M3_MAX, fp8_dense
+from shallowspeed_tpu.telemetry.health import (grad_health, note_step,
+                                               update_health)
+
+tree_map = jax.tree_util.tree_map
+
+# rolling absmax window (steps) behind the delayed activation scale
+AMAX_HISTORY = 16
+
+
+def init_fp8_mlp(sizes, seed: int = 0) -> dict:
+    """f32 master params for a dense ReLU MLP: He-scaled weights, zero
+    biases — `sizes` is [d_in, hidden..., d_out]."""
+    rng = np.random.default_rng(seed)
+    layers = []
+    for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+        w = rng.standard_normal((fan_in, fan_out)) * np.sqrt(2.0 / fan_in)
+        layers.append({"W": jnp.asarray(w, jnp.float32),
+                       "b": jnp.zeros((fan_out,), jnp.float32)})
+    return {"layers": layers}
+
+
+class Fp8TrainEngine:
+    """Single-device fp8 forward-matmul trainer (MSE regression head —
+    no exp/log keeps the range story about the QUANTIZED path). One
+    jitted step, params/opt-state/amax-history donated."""
+
+    def __init__(self, sizes, optimizer, seed: int = 0):
+        self.sizes = list(sizes)
+        self.opt = optimizer
+        self.params = init_fp8_mlp(sizes, seed)
+        self.opt_state = optimizer.init(self.params)
+        n_layers = len(sizes) - 1
+        # seed the history at 1.0 (scale ~ 1/448): conservative for
+        # O(1) activations, and never zero — the scale divide must be
+        # provably nonzero
+        self.amax_hist = jnp.ones((n_layers, AMAX_HISTORY), jnp.float32)
+        self.last_health = None
+        self._step_fn = jax.jit(self._step, donate_argnums=(0, 1, 2))
+        self._loss_fn = jax.jit(self._loss)
+
+    # ------------------------------------------------------- the step
+
+    def _forward(self, params, scales, x):
+        """Returns (prediction, per-layer input absmaxes). The absmax
+        is measured on the f32 input of each quantized matmul — the
+        stat the delayed scale of FUTURE steps is built from."""
+        h = x
+        amaxes = []
+        n = len(params["layers"])
+        for i, layer in enumerate(params["layers"]):
+            amaxes.append(jnp.max(jnp.abs(h)))
+            h = fp8_dense(h, layer["W"], scales[i]) + layer["b"]
+            if i < n - 1:
+                h = jax.nn.relu(h)
+        return h, jnp.stack(amaxes)
+
+    def _loss(self, params, amax_hist, x, y):
+        scales = self._scales(amax_hist)
+        pred, _ = self._forward(params, scales, x)
+        return jnp.mean(jnp.square(pred - y))
+
+    @staticmethod
+    def _scales(amax_hist):
+        """Delayed per-tensor activation scales: window max over the
+        amax history, floored away from zero."""
+        return jnp.maximum(jnp.max(amax_hist, axis=1) / E4M3_MAX, 1e-12)
+
+    def _step(self, params, opt_state, amax_hist, x, y):
+        scales = self._scales(amax_hist)
+
+        def loss_fn(p):
+            pred, amaxes = self._forward(p, scales, x)
+            return jnp.mean(jnp.square(pred - y)), amaxes
+
+        (loss, amaxes), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = self.opt.step(params, grads, opt_state)
+        # roll the window: slot 0 is this step's measurement
+        new_hist = jnp.roll(amax_hist, 1, axis=1).at[:, 0].set(amaxes)
+        pack = grad_health(params, grads)
+        pack = update_health(pack, params, new_params)
+        pack["fp8_amax"] = amaxes
+        pack["fp8_scale"] = scales
+        return new_params, new_opt, new_hist, loss, pack
+
+    # ---------------------------------------------------- public API
+
+    def train_batch(self, x, y) -> float:
+        (self.params, self.opt_state, self.amax_hist, loss,
+         pack) = self._step_fn(self.params, self.opt_state,
+                               self.amax_hist, x, y)
+        note_step(self, pack)
+        return float(loss)
+
+    def eval_loss(self, x, y) -> float:
+        return float(self._loss_fn(self.params, self.amax_hist, x, y))
+
+    def health_snapshot(self) -> dict | None:
+        from shallowspeed_tpu.telemetry.health import engine_snapshot
+        return engine_snapshot(self)

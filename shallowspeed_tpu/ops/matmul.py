@@ -118,3 +118,95 @@ def blocked_matmul(x, y, *, bm: int = 512, bk: int = 512, bn: int = 1024,
         interpret=interpret,
         **kw,
     )(x, y)
+
+
+# --------------------------------------------- fp8-e4m3 training matmul
+#
+# ROADMAP item 5's forward path (round 16): fp8-e4m3 storage for the
+# forward matmul's operands, with the same fused-dequant discipline as
+# `dequant_matmul` — the scale product lands on the f32 ACCUMULATOR,
+# never on an operand-sized buffer. The backward is a straight-through
+# estimator written by hand: naive autodiff through the quantization
+# casts would round-trip the COTANGENTS through e4m3 (a second
+# narrowing with no rescale — exactly what the analysis
+# `fp8-double-rounding` rule flags), so the custom VJP keeps gradients
+# f32 end-to-end and re-uses the stored fp8 operands only inside f32-
+# accumulated dots. The `fp8_train` analysis target proves all of this
+# statically on the traced step.
+
+E4M3_MAX = 448.0  # ml_dtypes.finfo(float8_e4m3fn).max
+
+
+def fp8_quantize(x, scale):
+    """`x / scale`, saturated to the e4m3 range and rounded once into
+    fp8 storage. The clip is what makes the convert provably in-range
+    for the analysis `range-safety` rule; the divide is the rescale
+    that pairs the quantized lineage to `scale` for `scale-consistency`
+    (and resets the rounding state for `fp8-double-rounding`)."""
+    y = x.astype(jnp.float32) / scale
+    return jnp.clip(y, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+
+
+def _w_scale(w):
+    """Just-in-time per-out-channel weight scale. `stop_gradient`: the
+    scale is quantization bookkeeping, not a trainable path."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    return jax.lax.stop_gradient(jnp.maximum(amax / E4M3_MAX, 1e-12))
+
+
+@jax.custom_vjp
+def fp8_dense(x, w, sx):
+    """x (B, K) @ w (K, N), both quantized to fp8-e4m3 for the dot:
+    `x` with the DELAYED per-tensor scale `sx` (from the caller's amax
+    history — this step's stats only feed the NEXT step's scale), `w`
+    with a just-in-time per-out-channel scale. f32 accumulation; the
+    dequant `* (sx * sw)` is reassociated onto the accumulator (both
+    scales are constant along the contraction axis). Returns (..., N)
+    f32. 2-D activations only (the hand VJP contracts the batch
+    axis for dw)."""
+    assert x.ndim == 2 and w.ndim == 2, (x.shape, w.shape)
+    sw = _w_scale(w)
+    acc = jax.lax.dot_general(
+        fp8_quantize(x, sx), fp8_quantize(w, sw),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc * (sx * sw)
+
+
+def _fp8_dense_fwd(x, w, sx):
+    assert x.ndim == 2 and w.ndim == 2, (x.shape, w.shape)
+    sw = _w_scale(w)
+    xq, wq = fp8_quantize(x, sx), fp8_quantize(w, sw)
+    acc = jax.lax.dot_general(
+        xq, wq, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return acc * (sx * sw), (xq, wq, sx, sw)
+
+
+def _fp8_dense_bwd(res, g):
+    """Straight-through estimator: quantization treated as identity, so
+    dx = g @ w^T and dw = x^T @ g, computed FROM the stored fp8
+    operands with every dequant on an f32 accumulator:
+
+    - dx: the cotangent arrives pre-multiplied by `sw` (the analysis
+      prover's "cotangent-scaled" form — `wq`'s scale rides the other
+      dot operand), and `sx` dequantizes the accumulator.
+    - dw: `xq`'s dequant by `sx` is reassociated onto the accumulator
+      (`sx` is per-tensor, constant along every axis).
+    - the scales get zero cotangents: bookkeeping, not parameters.
+
+    Saturated elements keep their pass-through gradient (plain STE; no
+    clip mask — delayed scaling keeps saturation rare by construction).
+    """
+    xq, wq, sx, sw = res
+    g = g.astype(jnp.float32)
+    dx = jax.lax.dot_general(
+        g * sw, wq, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sx
+    dw = jax.lax.dot_general(
+        xq, g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * sx
+    return dx, dw, jnp.zeros_like(sx)
+
+
+fp8_dense.defvjp(_fp8_dense_fwd, _fp8_dense_bwd)

@@ -630,28 +630,36 @@ class PipelineLMEngine:
             the global mean NLL plus every stage's weighted MoE aux."""
             s = jax.lax.axis_index("pp")
             is_first, is_last = s == 0, s == pp - 1
-            params = T.cast_params(params, cfg.compute_dtype)
             mubs, t = tokens.shape[1], tokens.shape[2]
             pos = tile_pos(t)
 
             def tick(carry, tk):
                 cur, loss_acc = carry
+                # cast INSIDE the tick: the scan's closed-over consts
+                # stay f32, so autodiff's derived backward accumulates
+                # each param's per-tick cotangent in an f32 carry (the
+                # cast's VJP upcasts per tick). Cast once outside and
+                # the grad sum re-rounds to bf16 every tick — the same
+                # bug the hand schedules avoid with `a + g.astype(f32)`.
+                # XLA hoists the loop-invariant forward cast.
+                params_c = T.cast_params(params, cfg.compute_dtype)
                 m = jnp.clip(tk - s, 0, n_mu - 1)
                 active = (tk - s >= 0) & (tk - s < n_mu)
                 tok_m = jax.lax.dynamic_index_in_dim(tokens, m, 0, False)
                 k_stage, k_emb = mu_key(key, m)
-                x_own = params["tok_emb"][tok_m]
+                x_own = params_c["tok_emb"][tok_m]
                 if not cfg.rope:  # rope replaces the learned pos embedding
-                    x_own = x_own + params["pos_emb"][pos]
+                    x_own = x_own + params_c["pos_emb"][pos]
                 if cfg.compute_dtype is not None:
                     x_own = x_own.astype(cfg.compute_dtype)
                 x_own = T._dropout(x_own, cfg.dropout, k_emb)
                 x_in = jnp.where(is_first, x_own, cur)
-                h, aux = apply_blocks(params["blocks"], x_in, pos, k_stage)
+                h, aux = apply_blocks(params_c["blocks"], x_in, pos,
+                                      k_stage)
                 # last stage: this microbatch's mean token NLL
-                hf = T._norm(params["ln_f"], h, cfg)
+                hf = T._norm(params_c["ln_f"], h, cfg)
                 tgt_m = jax.lax.dynamic_index_in_dim(targets, m, 0, False)
-                nll = head_nll(params, hf, tgt_m, train)
+                nll = head_nll(params_c, hf, tgt_m, train)
                 # every stage contributes its blocks' aux; only the last
                 # contributes the NLL — both masked to active ticks
                 contrib = jnp.where(active & is_last, nll, 0.0) \
@@ -690,17 +698,20 @@ class PipelineLMEngine:
             version). Backward = autodiff of this scan, like GPipe."""
             s = jax.lax.axis_index("pp")
             depth = pp * vpp
-            params = T.cast_params(params, cfg.compute_dtype)
             mubs, t = tokens.shape[1], tokens.shape[2]
             pos = jnp.arange(t)
             dt = cfg.compute_dtype or cfg.dtype
 
-            def chunk_blocks(v):
-                return tree_map(lambda l: l[v * lcv:(v + 1) * lcv],
-                                params["blocks"])
-
             def tick(carry, tk):
                 cur, loss_acc = carry      # cur: (vpp, mubs, t, d)
+                # cast inside the tick so backward accumulates param
+                # cotangents in f32 (see local_loss's tick)
+                params_c = T.cast_params(params, cfg.compute_dtype)
+
+                def chunk_blocks(v):
+                    return tree_map(lambda l: l[v * lcv:(v + 1) * lcv],
+                                    params_c["blocks"])
+
                 outs = []
                 for v in range(vpp):       # static unroll over chunks
                     logical = v * pp + s
@@ -713,9 +724,9 @@ class PipelineLMEngine:
                     k_stage, k_emb = mu_key(key, m)
                     if k_stage is not None:  # decorrelate chunks
                         k_stage = jax.random.fold_in(k_stage, v)
-                    x_own = params["tok_emb"][tok_m]
+                    x_own = params_c["tok_emb"][tok_m]
                     if not cfg.rope:
-                        x_own = x_own + params["pos_emb"][pos]
+                        x_own = x_own + params_c["pos_emb"][pos]
                     if cfg.compute_dtype is not None:
                         x_own = x_own.astype(cfg.compute_dtype)
                     x_own = T._dropout(x_own, cfg.dropout, k_emb)
@@ -731,8 +742,8 @@ class PipelineLMEngine:
                         contrib = (x_in[0, 0, 0] * 0).astype(
                             jnp.float32) + aux
                         if v == vpp - 1:  # the depth-1 logical stage
-                            hf = T._norm(params["ln_f"], h, cfg)
-                            nll = head_nll(params, hf, tgt_m, train)
+                            hf = T._norm(params_c["ln_f"], h, cfg)
+                            nll = head_nll(params_c, hf, tgt_m, train)
                             contrib = contrib + jnp.where(
                                 s == pp - 1, nll, 0.0)
                         return h, contrib

@@ -227,6 +227,7 @@ def fetch_pack(pack) -> dict | None:
     if pack is None:
         return None
     import jax
+    import numpy as np
 
     host = jax.device_get(pack)
     out = {
@@ -241,6 +242,11 @@ def fetch_pack(pack) -> dict | None:
     for k in ("skipped", "skipped_total", "nonfinite_steps_total"):
         if k in host:
             out[k] = int(host[k])
+    # fp8 delayed-scaling bookkeeping (fp8.Fp8TrainEngine): per-layer
+    # activation absmax and the scale it produced
+    for k in ("fp8_amax", "fp8_scale"):
+        if k in host:
+            out[k] = [float(v) for v in np.asarray(host[k]).ravel()]
     return out
 
 

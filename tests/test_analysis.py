@@ -5,11 +5,15 @@ Two layers:
 - **Per-rule toy fixtures**: one intentionally-bad jitted program per
   rule (accidental f32 promotion, missing donation, foreign-mesh
   collective, multi-cycle pp ppermute, unstable jit cache, over-budget
-  memory) asserting the rule FIRES, plus a clean twin asserting it
+  memory, stacked fp8 roundings, bf16 scan-carry grad sums, narrow
+  grad psums, forgotten/VJP-side quantization scales, provable range
+  overflows) asserting the rule FIRES, plus a clean twin asserting it
   stays quiet — the rules are tested like any other pure function.
 - **The tier-1 gate**: every shipped compiled train-step family
   (pipeline_lm GPipe/1F1B/interleaved/ZB-H1, gspmd, spmd_pipeline,
-  engine) must analyze to ZERO unsuppressed high-severity findings.
+  engine, serving decode, fp8_train) must analyze to ZERO unsuppressed
+  high-severity findings; plus the CLI contract (JSON format, baseline
+  diff mode, usage-error exit codes) and the stale-suppression audit.
 """
 
 from functools import partial
@@ -31,11 +35,11 @@ from shallowspeed_tpu.utils import shard_map
 
 
 def toy_probe(fn, args, donate=(), mesh=None, compute_dtype=None,
-              calls=0, budget=16 << 30, name="toy"):
+              calls=0, budget=16 << 30, name="toy", ranges=None):
     probe = TargetProbe(name, mesh, compute_dtype, hbm_budget=budget)
     probe.entrypoints = [EntryPoint(
         "fn", fn, tuple(args), tuple(f"arg{i}" for i in range(len(args))),
-        donate=tuple(donate), calls=calls)]
+        donate=tuple(donate), calls=calls, ranges=ranges)]
     return probe.seal()
 
 
@@ -427,6 +431,318 @@ def test_dequant_rule_clean_on_quantized_decode_tick():
     parametrized clean gate below via the 'serving' target.)"""
     results = analysis.analyze("serving", only=("dequant-fusion",))
     assert all(not fs for fs in results.values()), results
+
+
+# ----------------------------------------------- fp8 double rounding
+
+FP8 = getattr(jnp, "float8_e4m3fn", None)
+fp8_only = pytest.mark.skipif(FP8 is None,
+                              reason="no float8_e4m3fn in this build")
+
+
+@fp8_only
+def test_double_rounding_fires_on_stacked_narrowing():
+    @jax.jit
+    def bad(x):  # f32 -> bf16 -> e4m3: two roundings, no rescale
+        return x.astype(jnp.bfloat16).astype(FP8)
+
+    probe = toy_probe(bad, [sds((8, 8), jnp.float32)])
+    found = highs(run_rules(probe, only=("fp8-double-rounding",)))
+    assert found and "rounded again" in found[0].message
+
+
+@fp8_only
+def test_double_rounding_quiet_after_rescale():
+    @jax.jit
+    def clean(x, s):  # requantization done right: rescale FIRST
+        h = x.astype(jnp.bfloat16)
+        return (h.astype(jnp.float32) / s).astype(FP8)
+
+    probe = toy_probe(clean, [sds((8, 8), jnp.float32),
+                              sds((), jnp.float32)])
+    assert not run_rules(probe, only=("fp8-double-rounding",))
+
+
+def test_double_rounding_exempts_same_width_reround():
+    @jax.jit
+    def clean(x, b):  # the standard mixed-precision layernorm shape
+        h = x.astype(jnp.float32) + b.astype(jnp.float32)
+        return h.astype(jnp.bfloat16)
+
+    probe = toy_probe(clean, [sds((8, 8), jnp.bfloat16),
+                              sds((8,), jnp.bfloat16)])
+    assert not run_rules(probe, only=("fp8-double-rounding",))
+
+
+# ----------------------------------------------- accumulation dtype
+
+
+def test_accumulation_rule_fires_on_bf16_scan_carry():
+    @jax.jit
+    def bad(xs):  # the peeled-microbatch grad sum, done wrong
+        def tick(acc, x):
+            return acc + x, None
+
+        acc, _ = jax.lax.scan(tick, jnp.zeros((64,), jnp.bfloat16), xs)
+        return acc
+
+    probe = toy_probe(bad, [sds((4, 64), jnp.bfloat16)])
+    found = highs(run_rules(probe, only=("accumulation-dtype",)))
+    assert found and "carried accumulator" in found[0].message
+
+
+def test_accumulation_rule_quiet_on_f32_scan_carry():
+    @jax.jit
+    def clean(xs):  # the hand schedules' `a + g.astype(f32)` idiom
+        def tick(acc, x):
+            return acc + x.astype(jnp.float32), None
+
+        acc, _ = jax.lax.scan(tick, jnp.zeros((64,), jnp.float32), xs)
+        return acc.astype(jnp.bfloat16)
+
+    probe = toy_probe(clean, [sds((4, 64), jnp.bfloat16)])
+    assert not highs(run_rules(probe, only=("accumulation-dtype",)))
+
+
+def test_accumulation_rule_quiet_on_bf16_residual_stream():
+    @jax.jit
+    def clean(xs, w):  # h + f(h): f depends on the carry — NOT a sum
+        def tick(h, _):
+            return h + (h @ w).astype(h.dtype), None
+
+        h, _ = jax.lax.scan(tick, xs, None, length=3)
+        return h
+
+    probe = toy_probe(clean, [sds((8, 64), jnp.bfloat16),
+                              sds((64, 64), jnp.bfloat16)])
+    assert not highs(run_rules(probe, only=("accumulation-dtype",)))
+
+
+def test_accumulation_rule_fires_on_narrow_quant_dot():
+    @jax.jit
+    def bad(x, w):  # int8 weights, bf16 accumulator: K rounded away
+        return x @ w["Wq"].astype(jnp.bfloat16) * w["Ws"]
+
+    probe = toy_probe(bad, [sds((4, 32), jnp.bfloat16),
+                            {"Wq": sds((32, 16), jnp.int8),
+                             "Ws": sds((16,), jnp.bfloat16)}])
+    found = highs(run_rules(probe, only=("accumulation-dtype",)))
+    assert found and "quantized-storage" in found[0].message
+
+
+def test_accumulation_rule_quiet_on_f32_quant_dot():
+    @jax.jit
+    def clean(x, w):
+        acc = jax.lax.dot_general(
+            x, w["Wq"].astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc * w["Ws"]
+
+    probe = toy_probe(clean, [sds((4, 32), jnp.bfloat16),
+                              {"Wq": sds((32, 16), jnp.int8),
+                               "Ws": sds((16,), jnp.float32)}])
+    assert not highs(run_rules(probe, only=("accumulation-dtype",)))
+
+
+# --------------------------------------------- reduction precision
+
+
+def test_reduction_rule_fires_on_bf16_grad_psum():
+    mesh = dp_mesh2()
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P())
+    def bad(g):
+        return jax.lax.psum(g, "dp")
+
+    probe = toy_probe(bad, [sds((4, 64, 64), jnp.bfloat16)], mesh=mesh)
+    found = highs(run_rules(probe, only=("reduction-precision",)))
+    assert found and "re-rounds" in found[0].message
+
+
+def test_reduction_rule_quiet_on_f32_and_subkib():
+    mesh = dp_mesh2()
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
+             out_specs=(P(), P()))
+    def clean(g, stat):
+        return (jax.lax.psum(g.astype(jnp.float32), "dp"),
+                jax.lax.psum(stat, "dp"))  # sub-KiB statistic: exempt
+
+    probe = toy_probe(clean, [sds((4, 64, 64), jnp.bfloat16),
+                              sds((4,), jnp.bfloat16)], mesh=mesh)
+    assert not run_rules(probe, only=("reduction-precision",))
+
+
+# ----------------------------------------------- scale consistency
+
+
+def test_scale_rule_fires_on_forgotten_scale():
+    @jax.jit
+    def bad(x, w):  # Wq consumed, Ws never applied
+        return x @ w["Wq"].astype(jnp.float32)
+
+    probe = toy_probe(bad, [sds((4, 32), jnp.float32),
+                            {"Wq": sds((32, 16), jnp.int8),
+                             "Ws": sds((16,), jnp.float32)}])
+    found = highs(run_rules(probe, only=("scale-consistency",)))
+    assert found and "never applied" in found[0].message
+
+
+def test_scale_rule_quiet_on_accumulator_scale():
+    @jax.jit
+    def clean(x, w):
+        return (x @ w["Wq"].astype(jnp.float32)) * w["Ws"]
+
+    probe = toy_probe(clean, [sds((4, 32), jnp.float32),
+                              {"Wq": sds((32, 16), jnp.int8),
+                               "Ws": sds((16,), jnp.float32)}])
+    assert not run_rules(probe, only=("scale-consistency",))
+
+
+def test_scale_rule_resolves_vjp_cotangent_scaling():
+    """The transpose side: d/dx of a scaled quant matmul consumes Wq
+    in the backward dot with the scale riding the COTANGENT (g * Ws)
+    — a resolved pairing, not a forgotten scale."""
+    def f(x, w):
+        return ((x @ w["Wq"].astype(jnp.float32)) * w["Ws"]).sum()
+
+    g = jax.jit(jax.grad(f, argnums=0))
+    probe = toy_probe(g, [sds((4, 32), jnp.float32),
+                          {"Wq": sds((32, 16), jnp.int8),
+                           "Ws": sds((16,), jnp.float32)}])
+    assert not highs(run_rules(probe, only=("scale-consistency",)))
+
+
+def test_scale_rule_certifies_fp8_train_both_sides():
+    """The live target: in-program e4m3 quantization in fp8_dense must
+    pair every quantized operand to its delayed/JIT scale on the
+    forward AND the hand-VJP dots."""
+    results = analysis.analyze("fp8_train", only=("scale-consistency",))
+    assert all(not fs for fs in results.values()), results
+
+
+# --------------------------------------------------- range safety
+
+
+@fp8_only
+def test_range_rule_fires_on_unclamped_fp8_cast():
+    @jax.jit
+    def bad(x):
+        return x.astype(FP8)
+
+    probe = toy_probe(bad, [sds((8, 8), jnp.float32)],
+                      ranges={"arg0": (-1000.0, 1000.0)})
+    found = highs(run_rules(probe, only=("range-safety",)))
+    assert found and "overflows" in found[0].message
+
+
+@fp8_only
+def test_range_rule_quiet_on_saturating_clamp():
+    @jax.jit
+    def clean(x):
+        return jnp.clip(x, -448.0, 448.0).astype(FP8)
+
+    probe = toy_probe(clean, [sds((8, 8), jnp.float32)],
+                      ranges={"arg0": (-1000.0, 1000.0)})
+    assert not run_rules(probe, only=("range-safety",))
+
+
+def test_range_rule_fires_on_provable_exp_overflow():
+    @jax.jit
+    def bad(x):
+        return jnp.exp(x)
+
+    probe = toy_probe(bad, [sds((8,), jnp.float32)],
+                      ranges={"arg0": (120.0, 200.0)})
+    assert highs(run_rules(probe, only=("range-safety",)))
+
+
+def test_range_rule_quiet_on_shifted_softmax():
+    @jax.jit
+    def clean(x):  # x - max(x) <= 0: exp provably in range
+        return jax.nn.softmax(x, axis=-1)
+
+    probe = toy_probe(clean, [sds((4, 8), jnp.float32)],
+                      ranges={"arg0": (-500.0, 500.0)})
+    assert not run_rules(probe, only=("range-safety",))
+
+
+# ------------------------------- serialization, stale audit, baseline
+
+
+def test_finding_to_dict_and_key():
+    f = analysis.Finding("r", Severity.HIGH, "t", "s", ("pjit",),
+                         "boom (x3)")
+    d = f.to_dict()
+    assert d["severity"] == "HIGH" and d["path"] == ["pjit"]
+    assert d["key"] == "r|t|s|pjit|boom"  # dedup count stripped
+    assert f.key == d["key"]
+
+
+def test_stale_suppression_audit():
+    from shallowspeed_tpu.analysis.findings import stale_suppressions
+
+    snapshot = registered_suppressions()
+    try:
+        clear_suppressions()
+        s_used = suppress("donation", target="probe-a", reason="live")
+        suppress("donation", target="probe-a", match="nope",
+                 reason="documents a deviation that no longer exists")
+        suppress("donation", target="probe-b",
+                 reason="covers a probe that did not run")
+        hit = analysis.Finding("donation", Severity.HIGH, "probe-a",
+                               "fn", (), "x", suppressed="live",
+                               suppressed_by=s_used)
+        stale = stale_suppressions({"probe-a": [hit]},
+                                   ran_rules=("donation",))
+        assert len(stale) == 1  # only the matched-nothing registration
+        assert stale[0].severity == Severity.MEDIUM
+        assert stale[0].rule == "stale-suppression"
+        assert "matched no finding" in stale[0].message
+        # rule didn't run -> nothing can be proven stale
+        assert not stale_suppressions({"probe-a": [hit]},
+                                      ran_rules=("retrace",))
+    finally:
+        clear_suppressions(snapshot)
+
+
+def test_cli_json_and_baseline_roundtrip(tmp_path, capsys):
+    import json
+
+    from shallowspeed_tpu.analysis.__main__ import SCHEMA, main
+
+    base = tmp_path / "baseline.json"
+    assert main(["--target", "engine", "--write-baseline",
+                 str(base)]) == 0
+    capsys.readouterr()
+    doc = json.loads(base.read_text())
+    assert doc["schema"] == SCHEMA and doc["keys"] == []  # clean target
+
+    assert main(["--target", "engine", "--baseline", str(base),
+                 "--format", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["schema"] == SCHEMA
+    assert out["gate"] == 0 and "engine" in out["targets"]
+    fs = out["targets"]["engine"]["findings"]
+    assert fs and all(
+        set(f) >= {"rule", "severity", "target", "site", "path",
+                   "message", "suppressed", "key"} for f in fs)
+
+
+def test_cli_usage_errors_exit_two(tmp_path):
+    from shallowspeed_tpu.analysis.__main__ import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--target", "bogus"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        main(["--rules", "bogus"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        main(["--baseline", str(tmp_path / "missing.json")])
+    assert e.value.code == 2
 
 
 # ----------------------------------------------- the tier-1 clean gate
