@@ -859,6 +859,72 @@ def bench_fleet() -> dict:
         return {"fleet_error": repr(e)[:200]}
 
 
+def bench_profile_overhead(rounds: int = 5) -> dict:
+    """Profiler-on vs profiler-off serving throughput, INTERLEAVED
+    (round 17, telemetry/profiler): each round serves the identical
+    self-similar request set through a warm `ServingEngine` twice —
+    once under the always-on host sampler at its default rate, once
+    without — and the medians' ratio is the plane's overhead. The
+    interleaving puts load transients on both sides of the ratio
+    (`interleaved_medians`); BASELINE.md bands the acceptance at ±7%.
+    NOT on the default bench line (`python bench.py
+    --profile-overhead`) so the --regress trajectory keys stay
+    stable. Never raises — failures land as profile_overhead_error."""
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.serving import ServingEngine
+    from shallowspeed_tpu.telemetry.profiler import (DEFAULT_HZ,
+                                                     SamplingProfiler)
+
+    try:
+        cfg = T.TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                                  n_layers=2, max_seq=256)
+        params = jax.device_put(T.init(cfg, seed=0))
+        lens = [8, 20, 33, 48]
+        max_new = 24
+        offered = 8
+
+        def prompt(i):
+            t = lens[i % len(lens)]
+            motif = np.random.default_rng([7, i]).integers(
+                0, cfg.vocab, max(2, t // 3)).astype(np.int32)
+            reps = -(-t // motif.shape[0])
+            return np.concatenate([motif] * reps)[:t]
+
+        def run_once(profiled: bool) -> float:
+            eng = ServingEngine(params, cfg, n_blocks=96,
+                                block_size=16, max_slots=8,
+                                prefill_chunk=32)
+            for i in range(offered):
+                eng.submit(prompt(i), max_new, rid=f"p{i}")
+            prof = SamplingProfiler().start() if profiled else None
+            t0 = time.perf_counter()
+            eng.run()
+            wall = time.perf_counter() - t0
+            if prof is not None:
+                prof.stop()
+            toks = sum(r["tokens_out"] for r in eng.request_records)
+            return toks / wall
+
+        run_once(False)       # compile warmup (excluded)
+        meas = interleaved_medians(
+            {"off": lambda: run_once(False),
+             "on": lambda: run_once(True)}, rounds=rounds)
+        on, off = meas["on"]["median"], meas["off"]["median"]
+        return {"profile_overhead_case": {
+                    "hz": DEFAULT_HZ, "offered": offered,
+                    "tok_per_sec_off": round(off, 2),
+                    "tok_per_sec_on": round(on, 2),
+                    "rounds": {k: v["rounds"] for k, v in meas.items()},
+                    "spread": {k: v["spread"] for k, v in meas.items()},
+                },
+                # on/off: 1.0 = free, 0.93 = the 7% band edge
+                "profile_overhead_ratio": round(on / off, 4)}
+    except Exception as e:  # pragma: no cover — keep the bench robust
+        return {"profile_overhead_error": repr(e)[:200]}
+
+
 def pinned_baseline() -> float | None:
     """The once-recorded NumPy throughput (BASELINE.json) — the stable
     denominator for vs_baseline (VERDICT r1: a re-measured baseline made
@@ -927,5 +993,12 @@ if __name__ == "__main__":
 
     if "--overlap-child" in sys.argv[1:]:
         overlap_case_child()
+    elif "--profile-overhead" in sys.argv[1:]:
+        # standalone measurement (BASELINE.md's profiler-overhead
+        # record) — deliberately NOT part of the default bench line,
+        # whose keys the --regress trajectory gate bands
+        out = {"host_load": host_load_diagnostics()}
+        out.update(bench_profile_overhead())
+        print(json.dumps(out))
     else:
         main()

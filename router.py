@@ -77,6 +77,18 @@ def parse_args(argv=None):
                    help="extra raw serve.py args appended to every "
                         "replica's command (shlex-split), e.g. "
                         "'--weight-quant int8 --spec-k 4'")
+    s.add_argument("--profile", default="off",
+                   choices=["off", "host", "host+device"],
+                   help="continuous profiling plane (telemetry/"
+                        "profiler), forwarded to every replica "
+                        "(serve.py --profile) AND run router-side: "
+                        "each replica streams schema-v12 'profile' "
+                        "events the FleetCollector merges into a "
+                        "replica-labelled fleet flamegraph "
+                        "(/profile.json on the fleet endpoint), the "
+                        "router samples its own dispatch loop into "
+                        "--log-file, and a firing straggler event "
+                        "arms a router-side capture window")
     f = p.add_argument_group("fleet")
     f.add_argument("--replicas", type=int, default=2,
                    help="initial replica count")
@@ -209,7 +221,18 @@ def main(argv=None) -> int:
         model_args += ["--ckpt", args.ckpt]
     if args.platform:
         model_args += ["--platform", args.platform]
+    if args.profile != "off":
+        model_args += ["--profile", args.profile]
     model_args += shlex.split(args.replica_args)
+
+    # router-side profiling plane (round 17): the router's own host
+    # sampler (dispatch loop, progress polls) streams into --log-file;
+    # a firing straggler event arms a bounded capture window next to it
+    from shallowspeed_tpu.telemetry import profiler as profiler_mod
+
+    plane = profiler_mod.from_args(args, metrics, out_dir=run_dir)
+    if plane is not None:
+        collector.straggler_listeners.append(plane.on_straggler)
 
     def spawn(name: str) -> ReplicaProc:
         hb = str(run_dir / f"hb_{name}")
@@ -322,6 +345,8 @@ def main(argv=None) -> int:
         print(json.dumps({"event": "summary", **summary}),
               flush=True)
         router.shutdown()
+        if plane is not None:
+            plane.close()
         collector.stop()
         fleet_srv.close()
     return 0

@@ -143,6 +143,20 @@ def parse_args(argv=None):
                    help="wire SLO alerts into Engine.on_alert: pause "
                         "admission while a critical burn persists "
                         "(default: alerts are telemetry-only)")
+    o.add_argument("--profile", default="off",
+                   choices=["off", "host", "host+device"],
+                   help="continuous profiling plane (telemetry/"
+                        "profiler): 'host' runs the always-on stack "
+                        "sampler (schema-v12 'profile' events in the "
+                        "metrics JSONL, /profile.json on the monitor "
+                        "endpoint) and arms burn/fault/anomaly-"
+                        "triggered capture windows (profcap_*.json); "
+                        "'host+device' additionally wraps each "
+                        "capture in a bounded jax.profiler device "
+                        "trace")
+    o.add_argument("--profile-hz", type=float, default=None,
+                   help="host sampler rate (default 67 Hz — off the "
+                        "100/50 Hz scheduler beats)")
     p.add_argument("--platform", default=None,
                    help="jax platform override (e.g. cpu)")
     return p.parse_args(argv)
@@ -269,6 +283,20 @@ def main(argv=None) -> int:
               flush=True)
     if mon is not None and args.shed_load:
         mon.alert_listeners.append(eng.on_alert)
+    # continuous profiling plane (round 17): the always-on host stack
+    # sampler streams schema-v12 "profile" snapshots into the same
+    # metrics JSONL, and critical SLO burns / chaos fault stamps /
+    # anomaly verdicts arm bounded high-rate capture windows
+    # (profcap_<step>.json next to the flight-recorder dumps)
+    from shallowspeed_tpu.telemetry import profiler as profiler_mod
+
+    plane = profiler_mod.from_args(args, metrics)
+    if plane is not None:
+        chaos.add_observer(plane.on_fault)
+        if mon is not None:
+            mon.profiler = plane
+            mon.alert_listeners.append(plane.on_alert)
+    phase_tag = profiler_mod.tag     # no-op context when plane is off
     if args.fleet_register:
         # announce this replica to a fleet collector (best effort —
         # the fleet may come up after us and poll-register instead)
@@ -336,7 +364,8 @@ def main(argv=None) -> int:
                         {"event": "error", "id": r["id"],
                          "error": f"{type(e).__name__}: {e}"}))
             if gateway is not None:
-                gateway.pump(eng)
+                with phase_tag("gateway"):
+                    gateway.pump(eng)
             if eng.pending():
                 eng.step()
             elif i < len(reqs):
@@ -345,7 +374,8 @@ def main(argv=None) -> int:
                     and not gateway.drain_requested:
                 time.sleep(0.02)        # idle replica: await HTTP work
             if gateway is not None:
-                gateway.publish(eng)
+                with phase_tag("gateway"):
+                    gateway.publish(eng)
             for rec in eng.request_records[len(reported):]:
                 reported.add(rec["id"])
                 print(json.dumps({
@@ -404,6 +434,9 @@ def main(argv=None) -> int:
                 f"{eng.alloc.n_free}/{eng.alloc.n_usable}",
         })
         print(json.dumps({"event": "summary", **summary}), flush=True)
+        if plane is not None:
+            chaos.remove_observer(plane.on_fault)
+            plane.close()
         close_monitor(mon, server)
     return 0
 

@@ -101,6 +101,7 @@ import numpy as np
 from shallowspeed_tpu import chaos
 from shallowspeed_tpu.models import generate as G
 from shallowspeed_tpu.ops.flash_attention import paged_flash_decode
+from shallowspeed_tpu.telemetry.profiler import tag as phase_tag
 from shallowspeed_tpu.telemetry.trace import tracer
 from shallowspeed_tpu.telemetry.tracing import new_span_id, new_trace_id
 from shallowspeed_tpu.models import transformer as T
@@ -553,13 +554,21 @@ class ServingEngine:
         if plan is not None:
             # tick-indexed faults: a serving drill reuses the training
             # hooks — stall sleeps here (and must surface as replica
-            # skew the fleet's straggler detector names), kill/nan
-            # poison the params like a training step would
-            plan.on_data_load(self.counters["ticks"])
-            plan.on_step(self.counters["ticks"], engine=self)
-        did = self._admit()
-        did = self._prefill_step() or did
-        did = self._decode_step() or did
+            # skew the fleet's straggler detector names — AND, tagged,
+            # as the profiler capture's dominant host bucket), kill/
+            # nan poison the params like a training step would
+            with phase_tag("data-load"):
+                plan.on_data_load(self.counters["ticks"])
+                plan.on_step(self.counters["ticks"], engine=self)
+        # phase tags (round 17): name the scheduler's host buckets for
+        # the sampling profiler; phase_tag is a shared no-op unless a
+        # profiler is running
+        with phase_tag("block-alloc"):
+            did = self._admit()
+        with phase_tag("prefill-chunk"):
+            did = self._prefill_step() or did
+        with phase_tag("decode-tick"):
+            did = self._decode_step() or did
         return did
 
     def run(self, max_steps: int | None = None) -> dict:
@@ -734,11 +743,12 @@ class ServingEngine:
             # continuation index after a preemption) from the last
             # true position's logits, exactly like generate()'s
             # post-prefill sample
-            tok = _sample_jit(
-                logits, np.asarray([req.temp], np.float32),
-                np.asarray([req.seed], np.uint32),
-                np.asarray([len(req.generated)], np.int32),
-                top_k=self.top_k, top_p=self.top_p)
+            with phase_tag("sampling"):
+                tok = _sample_jit(
+                    logits, np.asarray([req.temp], np.float32),
+                    np.asarray([req.seed], np.uint32),
+                    np.asarray([len(req.generated)], np.int32),
+                    top_k=self.top_k, top_p=self.top_p)
             req.phase = "decode"
             self._lifecycle(req, "decoding")
             self._append_token(req, int(np.asarray(tok)[0]))
@@ -852,7 +862,8 @@ class ServingEngine:
                 self._append_token(r, tok_next)
                 emitted += 1
         self._win_tokens += emitted
-        self._maybe_log()
+        with phase_tag("logging"):
+            self._maybe_log()
         return True
 
     # ------------------------------------------------- spec decoding
@@ -935,7 +946,8 @@ class ServingEngine:
         `req` itself). Returns whether `req` is still running."""
         while req.written // self.block_size >= len(req.table):
             try:
-                req.table.extend(self.alloc.alloc(1))
+                with phase_tag("block-alloc"):
+                    req.table.extend(self.alloc.alloc(1))
             except OutOfBlocks:
                 live = [r for r in self.slots if r is not None]
                 victim = max(live, key=lambda r: r.admit_seq)

@@ -95,6 +95,13 @@ _LAZY = {
     "stitch": "tracing", "goodput_block": "tracing",
     "PHASE_COMPONENT": "tracing",
     "request_waterfall": "report",
+    # continuous profiling plane (round 17): always-on host sampler,
+    # span-tagged phase attribution, trigger-armed capture windows,
+    # the single jax.profiler entry point, flamegraph reduction
+    "SamplingProfiler": "profiler", "ProfilerPlane": "profiler",
+    "CaptureWindow": "profiler", "device_trace_ctx": "profiler",
+    "profiler_tag": "profiler", "merge_profiles": "profiler",
+    "flame_tree": "profiler", "profile_main": "profiler",
 }
 
 

@@ -11,6 +11,8 @@ run's goodput ledger, or watch a run live.
     python -m shallowspeed_tpu.telemetry --trace-stitch \
         run/router.jsonl run/replica_r0.jsonl run/replica_r1.jsonl \
         --out stitched.json
+    python -m shallowspeed_tpu.telemetry --profile run/metrics.jsonl \
+        --out flame.json
     python -m shallowspeed_tpu.telemetry --live run/metrics.jsonl
     python -m shallowspeed_tpu.telemetry --live f.jsonl --once
     python -m shallowspeed_tpu.telemetry --fleet http://127.0.0.1:9100 \
@@ -76,6 +78,13 @@ def main(argv=None) -> int:
                         "Perfetto-loadable Chrome trace to --out; "
                         "prints the clock fit and each request's "
                         "latency waterfall")
+    g.add_argument("--profile", nargs="+", metavar="JSONL",
+                   help="reduce the schema-v12 'profile' events of one "
+                        "or more metrics JSONLs (the host sampling "
+                        "profiler's cumulative snapshots; multiple "
+                        "files/stanzas merge replica-prefixed) to a "
+                        "flamegraph JSON (--out) + a printed "
+                        "top-frames/phases summary")
     g.add_argument("--live", metavar="JSONL",
                    help="tail a growing metrics JSONL and render the "
                         "live status view (the /status.json surface "
@@ -107,8 +116,15 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=None, metavar="PATH",
                    help="with --trace-stitch: where the Chrome trace "
                         "JSON lands (default: stitched_trace.json "
-                        "next to the first input)")
+                        "next to the first input); with --profile: "
+                        "where the flamegraph JSON lands (omitted = "
+                        "summary only)")
     args = p.parse_args(argv)
+
+    if args.profile:
+        from shallowspeed_tpu.telemetry.profiler import profile_main
+
+        return profile_main(args.profile, out=args.out)
 
     if args.trace_stitch:
         from shallowspeed_tpu.telemetry.tracing import stitch_main

@@ -123,6 +123,7 @@ class Replica:
         self.last_seen: float | None = None
         self.error: str | None = None
         self._status: dict = {}
+        self._profile: dict = {}    # latest profiler snapshot (rnd 17)
         self._exemplars: dict = {}
         self._rel_err: float | None = None
         self._sketches: dict[str, LogHistogram] = {}
@@ -171,6 +172,10 @@ class Replica:
             self._rel_err = float(payload["rel_err"])
             self._exemplars = payload["exemplars"]
             self._status = self._mon.status()
+            # file-fed replicas: the latest tailed "profile" event
+            # (cumulative snapshot — last wins) is this replica's
+            # contribution to the fleet flamegraph
+            self._profile = self._mon.last_profile or {}
             if n or self._mon.counters["lines"]:
                 self.alive = True
                 self.error = None
@@ -195,6 +200,14 @@ class Replica:
             return False
         self.fail_streak = 0
         self.backoff_s = 0.0
+        # /profile.json is best-effort and NEWER than the replicas'
+        # required surface: an error here (404 JSON body on a pre-v12
+        # replica, a profiler-off run) must not mark the replica down
+        try:
+            prof = self._get("/profile.json")
+            self._profile = prof if prof.get("enabled") else {}
+        except Exception:
+            self._profile = {}
         self._label = self._label or payload.get("label") \
             or self._status.get("replica")
         self._rel_err = float(payload.get("rel_err", 0.01))
@@ -216,6 +229,12 @@ class Replica:
 
     def sketch(self, name: str) -> LogHistogram | None:
         return self._sketches.get(name)
+
+    def profile(self) -> dict:
+        """This replica's latest profiler snapshot ({} = profiler off
+        or pre-v12 replica) — the fleet flamegraph's input."""
+        return {k: v for k, v in self._profile.items()
+                if k not in ("event", "t", "wall", "mono", "enabled")}
 
     def serialized_sketches(self) -> dict:
         return {name: sk.to_dict()
@@ -289,6 +308,10 @@ class FleetCollector:
         self.emit = emit
         self.log_file = str(log_file) if log_file else None
         self.events: list[dict] = []     # every straggler/alert emitted
+        # round 17: profiling-plane hooks — a firing straggler event
+        # invokes each listener(rec) (ProfilerPlane.on_straggler arms
+        # a capture window); a broken listener must not kill scoring
+        self.straggler_listeners: list = []
         self.active_alerts: dict[str, dict] = {}
         self.stragglers: dict[tuple, dict] = {}
         self.counters = {"refreshes": 0, "stragglers": 0, "alerts": 0,
@@ -518,6 +541,11 @@ class FleetCollector:
                         self._emit("straggler", rec, now)
                         self._flight_dump(
                             f"straggler:{name}:{metric}", rec)
+                        for listener in self.straggler_listeners:
+                            try:
+                                listener({"event": "straggler", **rec})
+                            except Exception:
+                                pass
                 else:
                     self._runs[key] = 0
                     if key in self.stragglers:
@@ -588,6 +616,21 @@ class FleetCollector:
         with self._lock:
             return self._status_locked(self.clock())
 
+    def profile_payload(self) -> dict:
+        """The fleet flamegraph (round 17): every profiling replica's
+        folded stacks merged replica-prefixed (one flamegraph whose
+        first level is the replica) — duck-typed onto StatusServer as
+        the fleet's /profile.json, same as a single Monitor's."""
+        from shallowspeed_tpu.telemetry.profiler import merge_profiles
+
+        with self._lock:
+            names = self._display_names()
+            snaps = {names[r.uid]: prof for r in self.replicas
+                     if (prof := r.profile())}
+        if not snaps:
+            return {"enabled": False}
+        return {"enabled": True, **merge_profiles(snaps)}
+
     def _status_locked(self, now: float) -> dict:
         names = self._display_names()
         merged, rel_err, skipped = self._merged()
@@ -626,11 +669,39 @@ class FleetCollector:
             "slowest_request": self._slowest_request(names),
             "counters": dict(self.counters),
         }
+        profiling = self._profiling_locked(names)
+        if profiling:
+            out["profiling"] = profiling
         if skipped:
             out["fleet"]["skipped_mixed_rel_err"] = skipped
         if self.flight is not None:
             out["flight_dumps"] = list(self.flight.dumps)
         return out
+
+    def _profiling_locked(self, names: dict) -> dict | None:
+        """The status-view digest of the fleet's profiling plane:
+        per-replica sample counts + the hottest frame, so "where is
+        host time going, per replica" is one /status.json read (the
+        full merged flamegraph lives on /profile.json)."""
+        per = {}
+        for rep in self.replicas:
+            prof = rep.profile()
+            if not prof:
+                continue
+            folded = prof.get("folded") or {}
+            top = max(folded.items(), key=lambda kv: kv[1])[0] \
+                if folded else None
+            ent = {"samples": int(prof.get("samples") or 0)}
+            phases = prof.get("phases") or {}
+            if phases:
+                ent["top_phase"] = max(phases.items(),
+                                       key=lambda kv: kv[1])[0]
+            if top is not None:
+                # the leaf frame is the "where": the full stack is on
+                # /profile.json, the status view wants one token
+                ent["top_frame"] = top.rsplit(";", 1)[-1]
+            per[names[rep.uid]] = ent
+        return {"replicas": per} if per else None
 
     def _slowest_request(self, names: dict) -> dict | None:
         worst = None

@@ -321,6 +321,11 @@ def run_goodput(path, extra_paths=()) -> dict:
         # offline percentiles above (same rank rule; they may differ
         # only by the sketch's recorded rel_err)
         "monitor": _monitor_block(stanzas, request_recs),
+        # None without schema-v12 profile snapshots — the host
+        # sampling profiler's story: where HOST time went, by tagged
+        # phase and hottest frames (last snapshot per stanza, merged
+        # labelled across restarts like the monitor sketches)
+        "profiling": _profiling_block(stanzas),
         # None without schema-v8 lifecycle events — aggregate
         # per-phase request time (where did request latency go:
         # queued vs prefill vs decoding vs preempted)
@@ -437,6 +442,53 @@ def _lifecycle_block(recs) -> dict | None:
                             if tl["complete"]),
             "by_phase_ms": {k: round(v, 3)
                             for k, v in sorted(by_phase.items())}}
+
+
+def _profiling_block(stanzas) -> dict | None:
+    """Reduce schema-v12 ``"profile"`` events to the run's host-time
+    story. Snapshots are cumulative, so the last per stanza is that
+    process's total (the monitor-block convention); multiple stanzas
+    merge replica/stanza-labelled via the profiler's own reducer."""
+    last: dict[str, dict] = {}
+    for k, st in enumerate(stanzas):
+        snaps = [r for r in st["lines"] if r.get("event") == "profile"]
+        if not snaps:
+            continue
+        label = next((r["replica"] for r in st["lines"]
+                      if r.get("event") == "run_start"
+                      and isinstance(r.get("replica"), str)), f"s{k}")
+        if label in last:
+            label = f"{label}#{k}"
+        last[label] = snaps[-1]
+    if not last:
+        return None
+    from shallowspeed_tpu.telemetry.profiler import (OTHER_KEY,
+                                                     merge_profiles)
+
+    if len(last) == 1:
+        (snap,) = last.values()
+        folded = dict(snap.get("folded") or {})
+        if snap.get("other"):
+            folded[OTHER_KEY] = (folded.get(OTHER_KEY, 0)
+                                 + int(snap["other"]))
+        merged = {"samples": int(snap.get("samples") or 0),
+                  "step_samples": int(snap.get("step_samples") or 0),
+                  "phases": dict(snap.get("phases") or {}),
+                  "folded": folded}
+    else:
+        merged = merge_profiles(last)
+        folded = dict(merged["folded"])
+    phases = {name: n for name, n
+              in sorted((merged.get("phases") or {}).items(),
+                        key=lambda kv: -kv[1])}
+    top = [{"frame": stack.rsplit(";", 1)[-1], "samples": int(n)}
+           for stack, n in sorted(folded.items(),
+                                  key=lambda kv: -kv[1])[:3]
+           if not stack.endswith(OTHER_KEY)]
+    return {"snapshots": len(last),
+            "samples": int(merged.get("samples") or 0),
+            "step_samples": int(merged.get("step_samples") or 0),
+            "phases": phases, "top_frames": top}
 
 
 def _monitor_block(stanzas, request_recs) -> dict | None:
@@ -591,6 +643,18 @@ def format_report(rep: dict) -> str:
         if bad:
             lines.append(f"  WARNING: sketch/offline parity out of "
                          f"bound: {bad}")
+    prof = rep.get("profiling")
+    if prof and prof["samples"]:
+        tot = prof["samples"]
+        parts = [f"{name} {n / tot:.0%}"
+                 for name, n in list(prof["phases"].items())[:4]]
+        lines.append(
+            f"profiling ({tot} host sample(s), {prof['snapshots']} "
+            f"snapshot(s)): " + "  ".join(parts))
+        if prof["top_frames"]:
+            hot = prof["top_frames"][0]
+            lines.append(f"  hottest frame: {hot['frame']} "
+                         f"({hot['samples'] / tot:.0%})")
     if rep.get("availability") is not None:
         lines.append(f"availability {rep['availability']:.2%}")
     lines.append(f"accounted {rep['accounted_frac'] if rep['accounted_frac'] is not None else '—'}"

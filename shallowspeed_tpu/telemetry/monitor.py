@@ -367,6 +367,14 @@ class Monitor:
         self.health = "ok"
         self.last_fault: dict | None = None
         self.last_step: dict | None = None
+        # continuous-profiling plane (round 17): the drivers attach
+        # their ProfilerPlane here so (a) /profile.json serves the
+        # live sampler state and (b) every flight-dump trigger
+        # (anomaly verdict, chaos fault, SLO burn) ALSO arms a
+        # high-rate capture window; tailer-mode monitors instead keep
+        # the stream's last cumulative "profile" snapshot
+        self.profiler = None
+        self.last_profile: dict | None = None
         self.serving: dict = {}
         # per-request lifecycle accounting (round 16): in-flight
         # phase-time accumulation keyed by request id, reduced on
@@ -569,6 +577,11 @@ class Monitor:
             self._flight_dump("anomaly:" + ",".join(
                 str(v) for v in verdicts), rec.get("step"), rec)
 
+    def _on_profile(self, rec: dict) -> None:
+        # tailer/fleet path: a file-fed replica's latest cumulative
+        # profiler snapshot (events are cumulative, so last wins)
+        self.last_profile = dict(rec)
+
     def _on_alert(self, rec: dict) -> None:
         # alerts from ANOTHER process's monitor (tailer mode): surface
         # them without re-evaluating
@@ -635,6 +648,17 @@ class Monitor:
             self._flight_dump(reason, step, trigger)
 
     def _flight_dump(self, reason: str, step, trigger) -> None:
+        # every incident that would dump the metrics ring also arms a
+        # profiler capture window (round 17) — the flight dump says
+        # what the run's NUMBERS were around the incident, the profcap
+        # says what its HOST was doing; the capture's own dedup/
+        # cooldown bounds it, independent of --flight-recorder
+        if self.profiler is not None:
+            try:
+                self.profiler.on_incident(reason, step=step,
+                                          trigger=trigger)
+            except Exception:
+                pass
         if not self.flight_enabled:
             return
         path = self.flight.dump(reason, step=step, trigger=trigger)
@@ -719,6 +743,22 @@ class Monitor:
                                          for v, rid in ex]
                                   for name, ex in self.exemplars.items()},
                     "counters": dict(self.counters)}
+
+    def profile_payload(self) -> dict:
+        """The /profile.json payload: the attached ProfilerPlane's
+        cumulative snapshot (live path), else the last "profile" event
+        seen in the stream (tailer path), else a typed
+        `{"enabled": False}` — an old or unprofiled replica answers
+        200 with a miss, and a fleet poller treats absence as
+        "no profile", never as "replica dead"."""
+        if self.profiler is not None:
+            return self.profiler.profile_payload()
+        with self._lock:
+            if self.last_profile is not None:
+                snap = {k: v for k, v in self.last_profile.items()
+                        if k not in ("event", "t", "wall", "mono")}
+                return {"enabled": True, "source": "log", **snap}
+        return {"enabled": False}
 
     def status(self) -> dict:
         """The /status.json payload."""
@@ -808,10 +848,18 @@ class StatusServer:
     an operator tunnel (ssh -L) is the expected transport, same as
     jax's profiler server.
 
+    Unknown paths answer 404 with a JSON error body (round 17) — a
+    TYPED miss, so a fleet poller probing /profile.json on an old
+    replica can distinguish "endpoint absent" (HTTP 404 + parseable
+    body) from "replica dead" (connection refused/timeout) without
+    burning availability.
+
     Duck-typed over `monitor`: anything with `status()`/`prometheus()`
     serves (a `fleet.FleetCollector` plugs in unchanged). Objects that
     also expose `sketch_payload()` get GET /sketches.json (the
     serialized mergeable sketches a fleet poller needs); objects with
+    `profile_payload()` get GET /profile.json (the continuous-profiler
+    snapshot a fleet merges into its flamegraph); objects with
     `register_replica(payload)` / `deregister_replica(payload)` get
     POST /register and /deregister (a replica announcing — or, on
     clean drain, withdrawing — its status URL at a fleet collector);
@@ -843,14 +891,23 @@ class StatusServer:
                  if find(meth) is not None}
         mon = monitor
         poll_requests = find("poll_requests")
+        profile_payload = find("profile_payload")
 
         class _Handler(BaseHTTPRequestHandler):
-            def _send(self, body: bytes, ctype: str) -> None:
-                self.send_response(200)
+            def _send(self, body: bytes, ctype: str,
+                      status: int = 200) -> None:
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _miss(self, path: str) -> None:
+                # typed 404: JSON body, so a poller can tell "endpoint
+                # absent on this replica" from "replica dead"
+                self._send(json.dumps(
+                    {"error": "not found", "path": path}).encode(),
+                    "application/json", status=404)
 
             def do_GET(self):
                 path = self.path.split("?")[0]
@@ -864,6 +921,11 @@ class StatusServer:
                         body = json.dumps(mon.sketch_payload(),
                                           default=str).encode()
                         ctype = "application/json"
+                    elif path == "/profile.json" \
+                            and profile_payload is not None:
+                        body = json.dumps(profile_payload(),
+                                          default=str).encode()
+                        ctype = "application/json"
                     elif path == "/requests" \
                             and poll_requests is not None:
                         body = json.dumps(poll_requests(),
@@ -874,7 +936,7 @@ class StatusServer:
                         ctype = ("text/plain; version=0.0.4; "
                                  "charset=utf-8")
                     else:
-                        self.send_error(404)
+                        self._miss(path)
                         return
                 except Exception as e:   # a status bug must not 500-loop
                     body = json.dumps({"error": repr(e)}).encode()
@@ -882,9 +944,10 @@ class StatusServer:
                 self._send(body, ctype)
 
             def do_POST(self):
-                fn = posts.get(self.path.split("?")[0])
+                path = self.path.split("?")[0]
+                fn = posts.get(path)
                 if fn is None:
-                    self.send_error(404)
+                    self._miss(path)
                     return
                 try:
                     n = int(self.headers.get("Content-Length") or 0)

@@ -102,12 +102,21 @@ from pathlib import Path
 # "submit" — the skew fit's lower bound); "route" grows `wait_ms`
 # (router submit -> dispatch) so the stitcher can recover the
 # fleet-edge submit time.
+# 12 = v11 plus the continuous-profiling extension (round 17,
+# `telemetry/profiler.py`): `"profile"` events — periodic CUMULATIVE
+# snapshots of the host sampling profiler (folded-stack top-K counts
+# + an exact `other` remainder, the span-tagged `phases` breakdown,
+# `step_samples` for the attrib_host_frac cross-check, `max_gap_ms`
+# the sampler-liveness bound) that merge across replicas like the v7
+# sketch snapshots: the LAST event per process stanza is that
+# stanza's whole story, and `python -m shallowspeed_tpu.telemetry
+# --profile <log> --out flame.json` reduces them to a flamegraph.
 # The validator accepts ALL dialects — every versioned field is
-# optional, so committed v1-v10 artifacts (no version stamp / no
+# optional, so committed v1-v11 artifacts (no version stamp / no
 # health / overlap / attrib / wall / fault / request / monitor /
-# straggler / lifecycle / speculation / routing / tracing fields)
-# keep validating unchanged.
-SCHEMA_VERSION = 11
+# straggler / lifecycle / speculation / routing / tracing / profile
+# fields) keep validating unchanged.
+SCHEMA_VERSION = 12
 
 _NUM = (int, float)
 
@@ -162,6 +171,10 @@ _METRIC_EVENTS = {
     "failover": {"id": str, "replica": str, "reason": str},
     # schema v10: one line per autoscale decision (up / drain / down)
     "scale": {"action": str},
+    # schema v12: periodic cumulative host-profiler snapshot
+    # (telemetry/profiler.SamplingProfiler) — folded-stack counts +
+    # span-tagged phase buckets, mergeable across replicas
+    "profile": {"samples": int},
 }
 
 # optional typed fields on a "ledger" line (`fail_class`: the
@@ -232,6 +245,18 @@ _FAILOVER_OPTIONAL = {"from": str, "tokens_done": int, "attempt": int,
                       "dispatch_wall": _NUM, "dispatch_mono": _NUM}
 _SCALE_OPTIONAL = {"replica": str, "reason": str, "n_replicas": int,
                    "burn": _NUM}
+
+# optional typed fields on the schema-v12 "profile" snapshot:
+# `folded` maps "frame;frame;..." strings to exact sample counts
+# (top-K; `other` is the exact remainder so counts still sum to
+# `samples`), `phases` maps innermost span-tag names to counts,
+# `step_samples` counts samples inside a step/batch span (the
+# attrib_host_frac cross-check), `max_gap_ms` is the worst
+# inter-sample gap (the GIL-safety bound the tests pin)
+_PROFILE_OPTIONAL = {"step_samples": int, "hz": _NUM, "top_k": int,
+                     "folded": dict, "other": int, "phases": dict,
+                     "max_gap_ms": _NUM, "window_s": _NUM,
+                     "mode": str, "captures": list}
 
 # telemetry fields a step line MAY carry; when present they must type
 _STEP_TELEMETRY = {
@@ -321,13 +346,14 @@ def _validate_metric(rec: dict) -> list[str]:
                 probs.append(f"generate: field {field!r} is "
                              f"{type(rec[field]).__name__}")
     if ev in ("monitor", "alert", "straggler", "lifecycle", "route",
-              "failover", "scale"):
+              "failover", "scale", "profile"):
         opt = {"monitor": _MONITOR_OPTIONAL, "alert": _ALERT_OPTIONAL,
                "straggler": _STRAGGLER_OPTIONAL,
                "lifecycle": _LIFECYCLE_OPTIONAL,
                "route": _ROUTE_OPTIONAL,
                "failover": _FAILOVER_OPTIONAL,
-               "scale": _SCALE_OPTIONAL}[ev]
+               "scale": _SCALE_OPTIONAL,
+               "profile": _PROFILE_OPTIONAL}[ev]
         for field, typ in opt.items():
             if field in rec and (not isinstance(rec[field], typ)
                                  or isinstance(rec[field], bool)):

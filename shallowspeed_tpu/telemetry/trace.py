@@ -64,6 +64,14 @@ class _NullSpan:
 
 _NULL_SPAN = _NullSpan()
 
+# phase hooks (round 17, telemetry/profiler.py): when a sampling
+# profiler runs it installs a (push, pop) pair here and every span
+# enter/exit feeds its NAME into the profiler's cross-thread phase
+# registry — so samples landing inside a `step` span are attributable
+# without the drivers changing. None when no profiler runs: the cost
+# on the hot path is one module-global read per span.
+PHASE_HOOKS = None
+
 
 class Span:
     """One timed region. Duration covers enter -> exit; `fence(arrs)`
@@ -92,10 +100,14 @@ class Span:
     def __enter__(self):
         self._t0 = self._tr._clock()
         self._tr._thread_stack().append(self)
+        if PHASE_HOOKS is not None:
+            PHASE_HOOKS[0](self.name)
         return self
 
     def __exit__(self, *exc):
         tr = self._tr
+        if PHASE_HOOKS is not None:
+            PHASE_HOOKS[1](self.name)
         if tr.level == "spans" and self._fences:
             _block(self._fences)
         t1 = tr._clock()

@@ -263,12 +263,16 @@ def test_regress_vacuous_on_short_trajectory(tmp_path):
 
 @pytest.mark.parametrize("driver", ["lm"])
 def test_driver_goodput_profile_and_decode_lines(tmp_path, driver):
-    """ONE tiny train_lm run covering three satellites: the xprof
-    --profile-dir capture smoke test (non-empty trace dir), the
-    goodput ledger's driver wiring (init/val/ckpt_save stamped, the
-    reducer accounts the run), and the decode progress line's
-    "generate" metrics event — plus spans-level attribution fields on
-    the step lines, all schema-valid."""
+    """ONE tiny train_lm run covering four satellites: the xprof
+    --profile-dir capture smoke test (a loadable, NON-EMPTY trace
+    artifact — not just a directory that exists), the goodput
+    ledger's driver wiring (init/val/ckpt_save stamped, the reducer
+    accounts the run), the decode progress line's "generate" metrics
+    event, and — with `--profile host+device` on the same run — the
+    continuous profiling plane riding the SAME device-capture entry
+    point (`profiler.device_trace_ctx`) as --profile-dir, streaming
+    schema-v12 profile events next to the spans-level attribution
+    fields, all schema-valid."""
     import train_lm
 
     log = tmp_path / "metrics.jsonl"
@@ -280,11 +284,15 @@ def test_driver_goodput_profile_and_decode_lines(tmp_path, driver):
          "--log-every", "2", "--val-every", "4", "--save-every", "4",
          "--save-dir", str(tmp_path / "ck"), "--log-file", str(log),
          "--profile-dir", str(prof), "--telemetry", "spans",
+         "--profile", "host+device",
          "--trace-dir", str(trace), "--prefetch", "0",
          "--generate", "8", "--seed", "0"]))
-    # xprof smoke: the capture wrote a non-empty trace directory
-    captured = [p for p in prof.rglob("*") if p.is_file()]
-    assert captured, "profiler trace directory is empty"
+    # xprof smoke, hardened (round 17): an empty directory or a
+    # zero-byte artifact used to pass — require a non-empty protobuf
+    # (xprof writes *.xplane.pb under plugins/profile/<ts>/)
+    pbs = [p for p in prof.rglob("*.pb") if p.stat().st_size > 0]
+    assert pbs, (f"no non-empty xprof .pb artifact under {prof}: "
+                 f"{[str(p) for p in prof.rglob('*') if p.is_file()]}")
     # schema: the v4 artifact validates end to end
     from shallowspeed_tpu.telemetry.schema import validate_file
 
@@ -297,6 +305,12 @@ def test_driver_goodput_profile_and_decode_lines(tmp_path, driver):
     gen = [r for r in recs if r["event"] == "generate"]
     assert len(gen) == 1 and gen[0]["tokens_per_sec"] > 0
     assert gen[0]["hbm_util"] is None  # CPU: no invented HBM peak
+    # the profiling plane ran alongside: schema-v12 snapshots landed,
+    # and host+device under an ACTIVE --profile-dir whole-run trace
+    # means capture windows would skip their device half (xprof
+    # doesn't nest) — the sampler itself must still stream
+    profs = [r for r in recs if r["event"] == "profile"]
+    assert profs and profs[-1]["samples"] > 0, profs
     # the reducer accounts the run (single process, generous band —
     # the strict >= 0.95 pin is the supervised kill/restart test)
     rep = run_goodput(log)

@@ -458,8 +458,21 @@ def test_status_server_serves_both_endpoints():
         prom = urllib.request.urlopen(srv.url("/metrics"),
                                       timeout=10).read().decode()
         assert prom.startswith("# TYPE shallowspeed_up gauge")
-        with pytest.raises(urllib.error.HTTPError):
+        # unknown paths 404 with a JSON body (round 17): scripted
+        # pollers get a parseable error naming the path, not the
+        # default HTML error page
+        with pytest.raises(urllib.error.HTTPError) as exc:
             urllib.request.urlopen(srv.url("/nope"), timeout=10)
+        assert exc.value.code == 404
+        body = json.loads(exc.value.read())
+        assert body["error"] == "not found" and body["path"] == "/nope"
+        assert exc.value.headers["Content-Type"].startswith(
+            "application/json")
+        # /profile.json without a profiling plane: enabled=False, not
+        # a 404 — the fleet poller treats it as "profiler off"
+        prof = json.loads(urllib.request.urlopen(
+            srv.url("/profile.json"), timeout=10).read())
+        assert prof == {"enabled": False}
     finally:
         srv.close()
 

@@ -128,6 +128,17 @@ def parse_args(argv=None):
                         "dumped to flightrec_<step>.json on anomaly "
                         "verdicts, chaos faults, or SLO alerts "
                         "(0 = off)")
+    p.add_argument("--profile", default="off",
+                   choices=["off", "host", "host+device"],
+                   help="continuous profiling plane (telemetry/"
+                        "profiler): always-on host stack sampler "
+                        "(schema-v12 'profile' events, span-tagged "
+                        "phase buckets when --telemetry is on) + "
+                        "burn/fault-triggered capture windows "
+                        "(profcap_*.json); 'host+device' wraps each "
+                        "capture in a bounded jax.profiler trace")
+    p.add_argument("--profile-hz", type=float, default=None,
+                   help="host sampler rate (default 67 Hz)")
     p.add_argument("--health", default="off",
                    choices=["off", "monitor", "guard"],
                    help="training-health observability (telemetry/"
@@ -268,8 +279,6 @@ def compute_accuracy(engine, val_ds) -> float:
 
 
 def train(args) -> float:
-    import contextlib
-
     import jax
 
     from shallowspeed_tpu import chaos, checkpoint
@@ -366,6 +375,18 @@ def train(args) -> float:
         if live_srv is not None:
             rprint(f"monitor: {live_srv.url('/status.json')} "
                    f"(+ /metrics)")
+    # continuous profiling plane (round 17): host stack sampler into
+    # the metrics JSONL + trigger-armed capture windows; tracer spans
+    # feed the sampler's phase buckets via trace.PHASE_HOOKS, so
+    # --telemetry steps/spans gives named host-time attribution
+    from shallowspeed_tpu.telemetry import profiler as profiler_mod
+
+    plane = profiler_mod.from_args(args, metrics)
+    if plane is not None:
+        chaos.add_observer(plane.on_fault)
+        if live_mon is not None:
+            live_mon.profiler = plane
+            live_mon.alert_listeners.append(plane.on_alert)
     if telem is not None and args.pp > 1:
         telem.set_bubble(bubble_static=tele.static_bubble(
             args.schedule, args.mubatches,
@@ -388,8 +409,12 @@ def train(args) -> float:
               if hasattr(engine, "train_epoch") and args.health == "off"
               else None)
 
-    profile_ctx = (jax.profiler.trace(args.profile_dir)
-                   if args.profile_dir else contextlib.nullcontext())
+    # the ONE jax.profiler entry point (telemetry/profiler): a falsy
+    # dir is a no-op, and an active whole-run trace makes the capture
+    # windows skip their own device half (xprof traces don't nest)
+    from shallowspeed_tpu.telemetry.profiler import device_trace_ctx
+
+    profile_ctx = device_trace_ctx(args.profile_dir)
     ledger.note("init", seconds=time.time() - t_proc0)
     start = time.time()
     accuracy = 0.0
@@ -500,6 +525,9 @@ def train(args) -> float:
         if args.trace_dir:
             path = telem.write_summary(args.trace_dir)
             rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
+    if plane is not None:
+        chaos.remove_observer(plane.on_fault)
+        plane.close()
     if live_mon is not None:
         chaos.remove_observer(live_mon.note_line)
         close_monitor(live_mon, live_srv)

@@ -327,6 +327,19 @@ def parse_args(argv=None):
                         "<step>.json (next to --log-file) when an "
                         "anomaly verdict fires, a chaos fault stamps, "
                         "or an SLO alert trips (0 = off)")
+    p.add_argument("--profile", default="off",
+                   choices=["off", "host", "host+device"],
+                   help="continuous profiling plane (telemetry/"
+                        "profiler): always-on host stack sampler "
+                        "streaming schema-v12 'profile' events into "
+                        "--log-file (step spans tag the samples when "
+                        "--telemetry is on, so host time decomposes "
+                        "into named buckets) + burn/fault/anomaly-"
+                        "triggered capture windows (profcap_*.json "
+                        "next to flightrec_*); 'host+device' wraps "
+                        "each capture in a bounded jax.profiler trace")
+    p.add_argument("--profile-hz", type=float, default=None,
+                   help="host sampler rate (default 67 Hz)")
     p.add_argument("--chaos", type=str, default="",
                    help="deterministic fault injection (shallowspeed_"
                         "tpu.chaos): a seeded plan like "
@@ -884,6 +897,18 @@ def train(args) -> float:
         if live_srv is not None:
             rprint(f"monitor: {live_srv.url('/status.json')} "
                    f"(+ /metrics)")
+    # continuous profiling plane (round 17): host stack sampler into
+    # the same metrics JSONL + trigger-armed capture windows; the
+    # tracer's step/phase spans tag each sample via trace.PHASE_HOOKS,
+    # so `--profile <log>` decomposes attrib_host_frac by name
+    from shallowspeed_tpu.telemetry import profiler as profiler_mod
+
+    plane = profiler_mod.from_args(args, metrics)
+    if plane is not None:
+        chaos.add_observer(plane.on_fault)
+        if live_mon is not None:
+            live_mon.profiler = plane
+            live_mon.alert_listeners.append(plane.on_alert)
     if telem is not None and hasattr(engine, "schedule_info"):
         # pipeline engines: the verified schedule's static bubble rides
         # on every step line from the start; the measured fraction
@@ -1003,6 +1028,9 @@ def train(args) -> float:
                 sample_and_print(args, engine, cfg, vocab, text_data,
                                  tokenizer, metrics=metrics)
         finally:
+            if plane is not None:
+                chaos.remove_observer(plane.on_fault)
+                plane.close()
             if live_mon is not None:
                 chaos.remove_observer(live_mon.note_line)
                 close_monitor(live_mon, live_srv)
@@ -1046,8 +1074,12 @@ def train(args) -> float:
     placed = prefetch_to_device(
         batches(), lambda b: (engine.place(b[0]), engine.place(b[1])),
         depth=args.prefetch)
-    profile_ctx = (jax.profiler.trace(args.profile_dir)
-                   if args.profile_dir else contextlib.nullcontext())
+    # the ONE jax.profiler entry point (telemetry/profiler): falsy dir
+    # = no-op; an active whole-run trace makes the profiling plane's
+    # capture windows skip their device half (xprof doesn't nest)
+    from shallowspeed_tpu.telemetry.profiler import device_trace_ctx
+
+    profile_ctx = device_trace_ctx(args.profile_dir)
     t_loop_done = None  # set at loop exit; teardown time is ledgered
     try:
         with profile_ctx:
@@ -1328,6 +1360,11 @@ def train(args) -> float:
             if args.trace_dir:
                 path = telem.write_summary(args.trace_dir)
                 rprint(f"telemetry: {path} (+ spans.jsonl, trace.json)")
+        if plane is not None:
+            # final profile snapshot + any in-flight capture land in
+            # the outputs before the monitor's own final snapshot
+            chaos.remove_observer(plane.on_fault)
+            plane.close()
         if live_mon is not None:
             # final sketch snapshot into the JSONL (the offline
             # merge/parity path reads it), then stop the endpoint
