@@ -666,6 +666,86 @@ def bench_attribution() -> dict:
         return {"attribution_error": repr(e)[:200]}
 
 
+def bench_fp8() -> dict:
+    """The fp8 attribution gate (round 18, ROADMAP item 5's rollout
+    contract): the SAME small transformer train step twice — a bf16
+    baseline and an `fp8_dense=True` case — each attributed by the
+    roofline waterfall with its own frozen self-scale (the RunTelemetry
+    protocol: window A fits `compute_scale`, window B is priced against
+    the frozen value, so `attrib_unexplained_frac` measures real
+    window-to-window stability, not a tautology). Quantized dense dots
+    are priced at `FP8_FLOPS_RATIO` x the MXU rate with 1-byte
+    operands, so the fp8-on case's `attrib_mxu_frac` must come out
+    STRICTLY below the baseline's while the quantize traffic lands in
+    the HBM term — the headline `fp8_mxu_shrink` (baseline mxu frac /
+    fp8 mxu frac, > 1.0 when the pricing holds) joins the --regress
+    trajectory gate. The line also carries the one-batch parity
+    rel-err between the two cases' losses (same init, same tokens) —
+    the static half of the shadow-parity envelope the runtime
+    observatory (telemetry/numerics.py) enforces live. Never raises —
+    a failure lands as fp8_error."""
+    import jax
+    import jax.numpy as jnp
+
+    from shallowspeed_tpu.models import transformer as tf
+    from shallowspeed_tpu.telemetry.attribution import (
+        device_rates, roofline_of_jaxpr, roofline_seconds,
+        step_waterfall)
+
+    if tf._FP8_DTYPE is None:
+        return {"fp8_error": "float8_e4m3fn unsupported in this build"}
+    try:
+        rng = np.random.default_rng(18)
+        toks = jnp.asarray(rng.integers(0, 64, (4, 32)), jnp.int32)
+        tgts = jnp.asarray(rng.integers(0, 64, (4, 32)), jnp.int32)
+        rates = device_rates(dtype="f32")
+        cases: dict = {}
+        first_loss: dict = {}
+        for name, fp8 in (("bf16", False), ("fp8", True)):
+            cfg = tf.TransformerConfig(
+                vocab=64, d_model=64, n_heads=4, n_layers=2, max_seq=32,
+                compute_dtype=jnp.bfloat16, fp8_dense=fp8)
+            params = tf.init(cfg, seed=0)
+
+            def step(p, x, y, cfg=cfg):
+                ls, g = jax.value_and_grad(tf.loss)(p, x, y, cfg)
+                return ls, jax.tree_util.tree_map(
+                    lambda w, gw: w - 1e-3 * gw, p, g)
+
+            roof = roofline_of_jaxpr(
+                jax.make_jaxpr(step)(params, toks, tgts))
+            secs = roofline_seconds(roof, rates)
+            jstep = jax.jit(step)
+            ls, params = jstep(params, toks, tgts)  # compile (excluded)
+            first_loss[name] = float(jax.device_get(ls))
+
+            def window(p, n=8):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    ls, p = jstep(p, toks, tgts)
+                jax.block_until_ready(ls)
+                return (time.perf_counter() - t0) / n, p
+
+            t_a, params = window(params)    # fits the self-scale ...
+            scale = t_a / max(secs["mxu_s"] + secs["hbm_s"], 1e-12)
+            t_b, params = window(params)    # ... window B runs frozen
+            fields = step_waterfall(t_b, roofline=roof, rates=rates,
+                                    compute_scale=scale)
+            fields["fp8_dot_flops"] = int(roof["flops_fp8_shard"]
+                                          + roof["flops_fp8_global"])
+            cases[name] = fields
+        shrink = (cases["bf16"]["attrib_mxu_frac"]
+                  / max(cases["fp8"]["attrib_mxu_frac"], 1e-9))
+        parity = (abs(first_loss["fp8"] - first_loss["bf16"])
+                  / max(abs(first_loss["bf16"]), 1e-12))
+        return {"fp8_mxu_shrink": round(shrink, 4),
+                "fp8_attribution": {
+                    **cases,
+                    "parity_loss_rel": round(parity, 6)}}
+    except Exception as e:  # pragma: no cover — keep the headline robust
+        return {"fp8_error": repr(e)[:200]}
+
+
 def bench_serving() -> dict:
     """Offered-load sweep of the serving runtime (round 11,
     `shallowspeed_tpu/serving/`): a small transformer served at
@@ -983,6 +1063,7 @@ def main():
     out.update(pg)
     out.update(bench_overlap())
     out.update(bench_attribution())
+    out.update(bench_fp8())
     out.update(bench_serving())
     out.update(bench_fleet())
     print(json.dumps(out))

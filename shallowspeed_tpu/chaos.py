@@ -77,7 +77,8 @@ ENV_SPEC = "SHALLOWSPEED_CHAOS"
 ENV_STATE = "SHALLOWSPEED_CHAOS_STATE"
 ENV_SEED = "SHALLOWSPEED_CHAOS_SEED"
 
-STEP_KINDS = ("kill", "nan", "inf", "stall", "freeze")
+STEP_KINDS = ("kill", "nan", "inf", "stall", "freeze",
+              "scale_poison")
 SAVE_KINDS = ("kill_in_save", "enospc", "corrupt")
 KINDS = STEP_KINDS + SAVE_KINDS
 
@@ -281,6 +282,13 @@ class FaultPlan:
                         f"chaos fault {f.id} needs an engine to poison")
                 leaf = self._poison(engine, f, kind)
                 self._fire(f, step=step, leaf=leaf)
+        f = self.due("scale_poison", step)
+        if f is not None:
+            if engine is None:
+                raise RuntimeError(
+                    f"chaos fault {f.id} needs an engine to poison")
+            layer = self._poison_scale(engine, f)
+            self._fire(f, step=step, layer=layer)
         f = self.due("kill", step)
         if f is not None:
             self._fire(f, step=step)
@@ -306,6 +314,25 @@ class FaultPlan:
                 f"a read-only view (use kill/stall/freeze/save faults "
                 f"with this engine)") from None
         return idx
+
+    def _poison_scale(self, engine, fault: Fault) -> int:
+        """Zero one seeded layer's fp8 amax history: its delayed scale
+        collapses to the 1e-12 divide floor next step, so every
+        quantize on that layer saturates — the numerics-observatory
+        failure mode (scale_collapse verdict + shadow-parity blowup)
+        rather than the nan/inf gradient storm. The params are
+        untouched; only the scaling STATE is corrupted, which is
+        exactly what a lost/corrupt amax sync looks like in the wild."""
+        hist = getattr(engine, "amax_hist", None)
+        if hist is None:
+            raise RuntimeError(
+                f"chaos fault {fault.id} needs an engine with an "
+                f"amax_hist (fp8 delayed scaling); "
+                f"{type(engine).__name__} has none — use "
+                f"kill/nan/inf/stall/freeze with this engine")
+        layer = int(self._rng(fault).integers(0, hist.shape[0]))
+        engine.amax_hist = hist.at[layer].set(0.0)
+        return layer
 
     def heartbeat_frozen(self) -> bool:
         return self._frozen

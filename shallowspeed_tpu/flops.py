@@ -109,6 +109,12 @@ def device_peak_flops(device=None, dtype: str = "bf16") -> float | None:
         return None
     if dtype in ("f32", "float32", "fp32"):
         return p / 8.0  # multi-pass MXU emulation; measured-practical
+    if dtype in ("fp8", "float8", "e4m3", "float8_e4m3fn"):
+        # dense fp8 runs the MXU at 2x its bf16 rate on generations
+        # that support it natively (see the v7 entry's 4.6PF -> 2.3
+        # note); the same 2x is what telemetry/attribution prices
+        # fp8-operand dot FLOPs at when building the roofline
+        return p * 2.0
     return p
 
 

@@ -18,11 +18,25 @@ one the static prover certifies (`analysis --target fp8_train`):
   cotangents through e4m3 — the exact `fp8-double-rounding` bug
   class), and parameters/optimizer state are f32 master copies.
 
-The runtime acceptance for longer runs is the PR-5 `attrib_mxu_frac`
-waterfall plus oracle loss-parity; what lives here is the statically
-certified step: the analysis gate proves no double rounding, f32
-accumulation everywhere, scale pairing on both dot sides (including
-the VJP), and in-range converts, before a long run is burned.
+Round 18 adds the RUNTIME half of the rollout gate on top of the
+static certificate:
+
+- the **numerics pack**: per-layer overflow/underflow fractions at
+  every activation quantize (`ops.matmul.fp8_clamp_stats`) join
+  `fp8_amax`/`fp8_scale` in the health pack — computed inside the same
+  compiled step (zero new executables, zero recompiles; pinned by
+  tests/test_numerics.py), reduced host-side by
+  `telemetry.numerics.NumericsMonitor`.
+- **shadow parity** (`shadow_parity(x, y)`): a frozen master-precision
+  oracle step on the same batch — no state update — reporting the
+  loss rel-err and worst-leaf gradient relmax of the quantized step
+  against f32. The drivers sample it every N steps (ledger-excluded as
+  `shadow_parity`) and feed the monitor's parity-drift detector.
+- a **bf16 fallback** (`fallback_bf16()`): the guard escalation's
+  middle rung — subsequent steps run the master-precision matmuls
+  while the amax history keeps rolling (state shapes, pack keys and
+  the scale series stay intact), so a run whose scales collapsed keeps
+  training inside the oracle's loss envelope instead of aborting.
 """
 
 from __future__ import annotations
@@ -31,7 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from shallowspeed_tpu.ops.matmul import E4M3_MAX, fp8_dense
+from shallowspeed_tpu.ops.matmul import (E4M3_MAX, fp8_clamp_stats,
+                                         fp8_dense)
 from shallowspeed_tpu.telemetry.health import (grad_health, note_step,
                                                update_health)
 
@@ -39,6 +54,12 @@ tree_map = jax.tree_util.tree_map
 
 # rolling absmax window (steps) behind the delayed activation scale
 AMAX_HISTORY = 16
+
+# engine compute modes: "fp8" is the quantized path the static prover
+# certifies; "bf16" is the master-precision fallback the numerics
+# guard escalates to (the matmuls run un-quantized; everything else —
+# amax bookkeeping, pack keys, state shapes — is unchanged)
+PRECISION_MODES = ("fp8", "bf16")
 
 
 def init_fp8_mlp(sizes, seed: int = 0) -> dict:
@@ -58,9 +79,20 @@ class Fp8TrainEngine:
     no exp/log keeps the range story about the QUANTIZED path). One
     jitted step, params/opt-state/amax-history donated."""
 
-    def __init__(self, sizes, optimizer, seed: int = 0):
+    def __init__(self, sizes, optimizer, seed: int = 0,
+                 precision: str = "fp8"):
+        if precision not in PRECISION_MODES:
+            raise ValueError(
+                f"unsupported precision={precision!r}; expected one of "
+                f"{PRECISION_MODES} (fp8 = quantized forward matmuls, "
+                f"bf16 = the master-precision fallback path)")
+        if len(sizes) < 2 or any(int(s) < 1 for s in sizes):
+            raise ValueError(
+                f"sizes must be [d_in, hidden..., d_out] with positive "
+                f"dims, got {list(sizes)!r}")
         self.sizes = list(sizes)
         self.opt = optimizer
+        self.precision = precision
         self.params = init_fp8_mlp(sizes, seed)
         self.opt_state = optimizer.init(self.params)
         n_layers = len(sizes) - 1
@@ -71,26 +103,53 @@ class Fp8TrainEngine:
         self.last_health = None
         self._step_fn = jax.jit(self._step, donate_argnums=(0, 1, 2))
         self._loss_fn = jax.jit(self._loss)
+        # the fallback step and the shadow-parity oracle are compiled
+        # LAZILY on first use: neither may add an executable to a run
+        # that never leaves the fp8 path (the zero-new-executables pin)
+        self._fallback_fn = None
+        self._parity_fn = None
 
     # ------------------------------------------------------- the step
 
     def _forward(self, params, scales, x):
-        """Returns (prediction, per-layer input absmaxes). The absmax
-        is measured on the f32 input of each quantized matmul — the
-        stat the delayed scale of FUTURE steps is built from."""
+        """Returns (prediction, per-layer input absmaxes, per-layer
+        (overflow, underflow) clamp fractions). The absmax is measured
+        on the f32 input of each quantized matmul — the stat the
+        delayed scale of FUTURE steps is built from; the clamp stats
+        describe what the clip did to THIS step's operands."""
+        h = x
+        amaxes, overflows, underflows = [], [], []
+        n = len(params["layers"])
+        for i, layer in enumerate(params["layers"]):
+            amaxes.append(jnp.max(jnp.abs(h)))
+            over, under = fp8_clamp_stats(h, scales[i])
+            overflows.append(over)
+            underflows.append(under)
+            h = fp8_dense(h, layer["W"], scales[i]) + layer["b"]
+            if i < n - 1:
+                h = jax.nn.relu(h)
+        return (h, jnp.stack(amaxes), jnp.stack(overflows),
+                jnp.stack(underflows))
+
+    def _oracle_forward(self, params, x):
+        """The frozen master-precision forward: same architecture, f32
+        matmuls, no quantize — the parity oracle and the bf16-fallback
+        step's compute path. Absmaxes are still measured so the amax
+        history keeps rolling under fallback."""
         h = x
         amaxes = []
         n = len(params["layers"])
         for i, layer in enumerate(params["layers"]):
             amaxes.append(jnp.max(jnp.abs(h)))
-            h = fp8_dense(h, layer["W"], scales[i]) + layer["b"]
+            h = jnp.dot(h, layer["W"],
+                        preferred_element_type=jnp.float32) + layer["b"]
             if i < n - 1:
                 h = jax.nn.relu(h)
         return h, jnp.stack(amaxes)
 
     def _loss(self, params, amax_hist, x, y):
         scales = self._scales(amax_hist)
-        pred, _ = self._forward(params, scales, x)
+        pred, _, _, _ = self._forward(params, scales, x)
         return jnp.mean(jnp.square(pred - y))
 
     @staticmethod
@@ -103,10 +162,10 @@ class Fp8TrainEngine:
         scales = self._scales(amax_hist)
 
         def loss_fn(p):
-            pred, amaxes = self._forward(p, scales, x)
-            return jnp.mean(jnp.square(pred - y)), amaxes
+            pred, amaxes, over, under = self._forward(p, scales, x)
+            return jnp.mean(jnp.square(pred - y)), (amaxes, over, under)
 
-        (loss, amaxes), grads = jax.value_and_grad(
+        ((loss, (amaxes, over, under)), grads) = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         new_params, new_opt = self.opt.step(params, grads, opt_state)
         # roll the window: slot 0 is this step's measurement
@@ -115,16 +174,93 @@ class Fp8TrainEngine:
         pack = update_health(pack, params, new_params)
         pack["fp8_amax"] = amaxes
         pack["fp8_scale"] = scales
+        pack["fp8_overflow"] = over
+        pack["fp8_underflow"] = under
         return new_params, new_opt, new_hist, loss, pack
+
+    def _step_bf16(self, params, opt_state, amax_hist, x, y):
+        """The fallback step: master-precision matmuls, IDENTICAL state
+        and pack structure. Clamp fractions are exact zeros (nothing is
+        quantized) and the amax history keeps rolling, so a later
+        return to fp8 starts from fresh scales, not stale ones."""
+        scales = self._scales(amax_hist)
+
+        def loss_fn(p):
+            pred, amaxes = self._oracle_forward(p, x)
+            return jnp.mean(jnp.square(pred - y)), amaxes
+
+        (loss, amaxes), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = self.opt.step(params, grads, opt_state)
+        new_hist = jnp.roll(amax_hist, 1, axis=1).at[:, 0].set(amaxes)
+        pack = grad_health(params, grads)
+        pack = update_health(pack, params, new_params)
+        pack["fp8_amax"] = amaxes
+        pack["fp8_scale"] = scales
+        zeros = jnp.zeros_like(scales)
+        pack["fp8_overflow"] = zeros
+        pack["fp8_underflow"] = zeros
+        return new_params, new_opt, new_hist, loss, pack
+
+    def _parity(self, params, amax_hist, x, y):
+        """Shadow-parity probe: the quantized loss/grads and the frozen
+        f32-oracle loss/grads on the SAME batch, no state update.
+        Returns (loss_rel_err, worst-leaf grad relmax) — the runtime
+        loss-parity gate's two scalars."""
+        scales = self._scales(amax_hist)
+
+        def q_loss(p):
+            pred, _, _, _ = self._forward(p, scales, x)
+            return jnp.mean(jnp.square(pred - y))
+
+        def o_loss(p):
+            pred, _ = self._oracle_forward(p, x)
+            return jnp.mean(jnp.square(pred - y))
+
+        ql, qg = jax.value_and_grad(q_loss)(params)
+        ol, og = jax.value_and_grad(o_loss)(params)
+        loss_rel = jnp.abs(ql - ol) / jnp.maximum(jnp.abs(ol), 1e-12)
+
+        def leaf_rel(a, b):
+            return jnp.max(jnp.abs(a - b)) / jnp.maximum(
+                jnp.max(jnp.abs(b)), 1e-12)
+
+        rels = tree_map(leaf_rel, qg, og)
+        grad_relmax = jnp.max(jnp.stack(
+            jax.tree_util.tree_leaves(rels)))
+        return loss_rel, grad_relmax
 
     # ---------------------------------------------------- public API
 
     def train_batch(self, x, y) -> float:
+        if self.precision == "bf16":
+            if self._fallback_fn is None:
+                self._fallback_fn = jax.jit(self._step_bf16,
+                                            donate_argnums=(0, 1, 2))
+            step_fn = self._fallback_fn
+        else:
+            step_fn = self._step_fn
         (self.params, self.opt_state, self.amax_hist, loss,
-         pack) = self._step_fn(self.params, self.opt_state,
-                               self.amax_hist, x, y)
+         pack) = step_fn(self.params, self.opt_state,
+                         self.amax_hist, x, y)
         note_step(self, pack)
         return float(loss)
+
+    def fallback_bf16(self) -> None:
+        """Switch subsequent steps to the master-precision fallback —
+        the guard escalation's middle rung. Idempotent."""
+        self.precision = "bf16"
+
+    def shadow_parity(self, x, y) -> dict:
+        """One ledger-excluded oracle comparison on `(x, y)` — the
+        caller stamps the seconds as `shadow_parity`. Returns host
+        floats ready for `NumericsMonitor.note_parity`."""
+        if self._parity_fn is None:
+            self._parity_fn = jax.jit(self._parity)
+        loss_rel, grad_relmax = self._parity_fn(
+            self.params, self.amax_hist, x, y)
+        return {"parity_loss_rel": float(loss_rel),
+                "parity_grad_relmax": float(grad_relmax)}
 
     def eval_loss(self, x, y) -> float:
         return float(self._loss_fn(self.params, self.amax_hist, x, y))

@@ -135,7 +135,7 @@ class StepRates:
 
     def __init__(self, tokens_per_step: float, clock=time.time,
                  telemetry=None, health=None, ledger=None,
-                 monitor=None):
+                 monitor=None, numerics=None):
         self.tokens_per_step = float(tokens_per_step)
         self._clock = clock
         self._t0 = clock()
@@ -153,6 +153,12 @@ class StepRates:
         # fields (grad/param norms, update ratio, nonfinite counter,
         # skipped-step counter, anomaly verdicts)
         self.health = health
+        # optional telemetry.numerics.NumericsMonitor: when set, every
+        # log_point line additionally carries the fp8 numerics fields
+        # (clamp fractions, scale/amax extrema, drift/oscillation
+        # scores, shadow-parity rel-errs, numerics verdicts — the
+        # schema-v13 num_* dialect)
+        self.numerics = numerics
         # optional telemetry.goodput.GoodputLedger: every `pause` is
         # ALSO stamped as a ledger event of its kind, so the
         # throughput windows and the run-level goodput ledger can
@@ -203,6 +209,8 @@ class StepRates:
             self.monitor.observe("tok_s", win)
         if self.health is not None:
             out.update(self.health.step_fields())
+        if self.numerics is not None:
+            out.update(self.numerics.step_fields())
         if self.telemetry is not None:
             out.update(self.telemetry.step_fields(
                 window_secs=win_secs,

@@ -161,6 +161,17 @@ class TransformerConfig:
     # B*T=8k the classic path writes a ~1GB f32 log-prob residual;
     # chunked keeps O(chunk * vocab) transients only.
     xent_chunk: int = 0
+    # fp8-e4m3 forward matmuls (round 18 — ROADMAP item 5's runtime
+    # rung reaching the transformer): every dense projection (qkv/
+    # q+kv, proj, up/down/gate, the untied head) runs
+    # `ops.matmul.fp8_dense` — activations quantized with a
+    # just-in-time per-tensor stop_gradient scale, weights with the
+    # per-out-channel scale, f32 accumulation, straight-through
+    # backward. Embeddings, norms and MoE banks stay in compute_dtype
+    # (same exclusions as `quantize_weights`). The attribution gate
+    # (bench.py's fp8 case) pins that this flag shrinks
+    # attrib_mxu_frac vs the bf16 baseline while shadow parity holds.
+    fp8_dense: bool = False
 
     def __post_init__(self):
         assert self.norm in ("layernorm", "rmsnorm"), self.norm
@@ -179,6 +190,11 @@ class TransformerConfig:
         assert self.n_heads % self.kv_heads == 0, (
             f"n_heads={self.n_heads} must be divisible by "
             f"n_kv_heads={self.kv_heads}")
+        # typed, not an assert: this gates a production precision mode
+        if self.fp8_dense and _FP8_DTYPE is None:
+            raise ValueError(
+                "fp8_dense=True needs float8_e4m3fn support in this "
+                "jax/XLA build; train in bf16/f32 instead")
 
     @property
     def head_dim(self) -> int:
@@ -386,12 +402,27 @@ def _norm(p, x, cfg: TransformerConfig):
     return (_rmsnorm if cfg.norm == "rmsnorm" else _layernorm)(p, x)
 
 
-def _dense(p, x):
+def _dense(p, x, fp8: bool = False):
     if "Wq" in p:  # quantized storage (`quantize_weights`): the scale
         #            lands on the f32 accumulator, never on the weight
         from shallowspeed_tpu.ops.matmul import dequant_matmul
 
         return dequant_matmul(x, p["Wq"], p["Ws"]) + p["b"]
+    if fp8:  # cfg.fp8_dense: the training-time quantized matmul. The
+        #      activation scale is just-in-time per-tensor (unlike the
+        #      Fp8TrainEngine's delayed history — a stateless model
+        #      function has nowhere to carry one) and stop_gradient:
+        #      the clip is exact-in-range by construction, so the
+        #      analysis range rule holds without calibration state.
+        from shallowspeed_tpu.ops.matmul import E4M3_MAX, fp8_dense
+
+        w = p["W"]
+        x2 = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+        amax = jax.lax.stop_gradient(jnp.max(jnp.abs(x2)))
+        sx = jnp.maximum(amax / E4M3_MAX, 1e-12)
+        out = fp8_dense(x2, w.astype(jnp.float32), sx)
+        return (out.reshape(*x.shape[:-1], w.shape[-1]).astype(x.dtype)
+                + p["b"])
     return x @ p["W"] + p["b"]
 
 
@@ -411,7 +442,7 @@ def head_logits(params, x, cfg: TransformerConfig):
     soft-capped (`cfg.logit_softcap`), in f32 so tanh saturation is not
     computed in bf16."""
     logits = (x @ params["tok_emb"].T if cfg.tie_embeddings
-              else _dense(params["head"], x))
+              else _dense(params["head"], x, cfg.fp8_dense))
     if cfg.logit_softcap > 0.0:
         cap = cfg.logit_softcap
         logits = cap * jnp.tanh(logits.astype(jnp.float32) / cap)
@@ -531,12 +562,14 @@ def _qkv(p, h, cfg: TransformerConfig):
     the fused head-major qkv, or split q / fused kv under GQA."""
     b, t, _ = h.shape
     if "kv" in p:
-        q = _dense(p["q"], h).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        kv = _dense(p["kv"], h).reshape(b, t, cfg.kv_heads, 2, cfg.head_dim)
+        q = _dense(p["q"], h, cfg.fp8_dense).reshape(
+            b, t, cfg.n_heads, cfg.head_dim)
+        kv = _dense(p["kv"], h, cfg.fp8_dense).reshape(
+            b, t, cfg.kv_heads, 2, cfg.head_dim)
         k, v = kv[..., 0, :], kv[..., 1, :]
     else:
-        qkv = _dense(p["qkv"], h).reshape(b, t, cfg.n_heads, 3,
-                                          cfg.head_dim)
+        qkv = _dense(p["qkv"], h, cfg.fp8_dense).reshape(
+            b, t, cfg.n_heads, 3, cfg.head_dim)
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
     return q, k, v
 
@@ -579,10 +612,12 @@ def _ffn(p, x, cfg: TransformerConfig, h, key=None):
                                 priority=cfg.moe_routing == "priority")
         return x + _dropout(y, cfg.dropout, key), (aux, z, st)
     if "gate" in p:  # SwiGLU: silu(gate) * up, both column-parallel
-        u = jax.nn.silu(_dense(p["gate"], h)) * _dense(p["up"], h)
+        u = jax.nn.silu(_dense(p["gate"], h, cfg.fp8_dense)) \
+            * _dense(p["up"], h, cfg.fp8_dense)
     else:
-        u = jax.nn.gelu(_dense(p["up"], h))
-    return (x + _dropout(_dense(p["down"], u), cfg.dropout, key),
+        u = jax.nn.gelu(_dense(p["up"], h, cfg.fp8_dense))
+    return (x + _dropout(_dense(p["down"], u, cfg.fp8_dense),
+                         cfg.dropout, key),
             (0.0, 0.0, None))
 
 
@@ -632,7 +667,8 @@ def _block(p, x, cfg: TransformerConfig, attn_fn, with_kv: bool = False,
     # value so the backward replay never re-runs the attention substrate
     # (no-op outside a policied jax.checkpoint)
     a = _checkpoint_name(a, "attn_out")
-    x = x + _dropout(_dense(p["proj"], a), cfg.dropout, k_attn)
+    x = x + _dropout(_dense(p["proj"], a, cfg.fp8_dense),
+                     cfg.dropout, k_attn)
     h = _norm(p["ln2"], x, cfg)
     x, aux = _ffn(p, x, cfg, h, k_ffn)
     if with_kv:

@@ -135,6 +135,50 @@ def blocked_matmul(x, y, *, bm: int = 512, bk: int = 512, bn: int = 1024,
 # statically on the traced step.
 
 E4M3_MAX = 448.0  # ml_dtypes.finfo(float8_e4m3fn).max
+E4M3_TINY = 2.0 ** -9  # smallest e4m3 subnormal (1 * 2^-9)
+
+
+def _check_fp8_operands(x, w):
+    """fp8_dense's shape contract as a typed error (the repo's
+    config-validation convention): the hand VJP contracts the batch
+    axis for dw, so only 2-D activations/weights are expressible."""
+    if x.ndim != 2 or w.ndim != 2:
+        raise ValueError(
+            f"fp8_dense takes 2-D operands x (B, K) @ w (K, N); got "
+            f"x.shape={tuple(x.shape)}, w.shape={tuple(w.shape)} — "
+            f"reshape (..., K) activations to (-1, K) at the call site")
+    if x.shape[1] != w.shape[0]:
+        raise ValueError(
+            f"fp8_dense contraction mismatch: x (B, K={x.shape[1]}) @ "
+            f"w (K={w.shape[0]}, N)")
+
+
+def fp8_clamp_stats(x, scale):
+    """Traced per-tensor clamp statistics for one activation quantize —
+    the numerics pack's raw ingredients, computed on the SAME (x,
+    scale) pair `fp8_quantize` sees so the fractions describe exactly
+    what the dot consumed:
+
+    - overflow: fraction of elements saturated by the ±E4M3_MAX clip
+      (a too-SMALL delayed scale — amax history collapsed or lagging a
+      range expansion);
+    - underflow: fraction of NONZERO elements that round to zero in
+      e4m3 (|x/scale| below half the smallest subnormal — a too-LARGE
+      scale flushing real signal; exact zeros are excluded so ReLU
+      sparsity does not read as underflow).
+
+    Returns two f32 scalars; a handful of VPU ops per call, designed to
+    ride the compiled step under the health pack's zero-new-executables
+    contract. The weight side is deliberately not measured: its
+    just-in-time per-out-channel scale makes saturation impossible by
+    construction."""
+    y = jnp.abs(x.astype(jnp.float32)) / scale
+    overflow = jnp.mean((y > E4M3_MAX).astype(jnp.float32))
+    nz = y > 0.0
+    under = jnp.logical_and(nz, y < 0.5 * E4M3_TINY)
+    denom = jnp.maximum(jnp.sum(nz.astype(jnp.float32)), 1.0)
+    underflow = jnp.sum(under.astype(jnp.float32)) / denom
+    return overflow, underflow
 
 
 def fp8_quantize(x, scale):
@@ -164,7 +208,7 @@ def fp8_dense(x, w, sx):
     scales are constant along the contraction axis). Returns (..., N)
     f32. 2-D activations only (the hand VJP contracts the batch
     axis for dw)."""
-    assert x.ndim == 2 and w.ndim == 2, (x.shape, w.shape)
+    _check_fp8_operands(x, w)
     sw = _w_scale(w)
     acc = jax.lax.dot_general(
         fp8_quantize(x, sx), fp8_quantize(w, sw),
@@ -174,7 +218,7 @@ def fp8_dense(x, w, sx):
 
 
 def _fp8_dense_fwd(x, w, sx):
-    assert x.ndim == 2 and w.ndim == 2, (x.shape, w.shape)
+    _check_fp8_operands(x, w)
     sw = _w_scale(w)
     xq, wq = fp8_quantize(x, sx), fp8_quantize(w, sw)
     acc = jax.lax.dot_general(

@@ -36,7 +36,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
-ACTIONS = ("warn", "skip_step", "abort")
+ACTIONS = ("warn", "skip_step", "fallback_bf16", "abort")
 
 
 class RobustEWMA:
@@ -98,6 +98,14 @@ class GuardPolicy:
     grad_spike: str = "warn"
     divergence: str = "warn"
     dead_layer: str = "warn"
+    # numerics-observatory kinds (round 18, telemetry/numerics.py):
+    # shadow-parity drift and a collapsed delayed scale. Under guard
+    # their action is `fallback_bf16` — the quantized path is the
+    # OPTIONAL precision, so the proportionate response is to stop
+    # quantizing, not to stop training; the NumericsMonitor escalates
+    # a verdict that repeats AFTER the fallback to abort.
+    parity_drift: str = "warn"
+    scale_collapse: str = "warn"
 
     def action(self, kind: str) -> str:
         act = getattr(self, kind, "warn")
@@ -112,7 +120,9 @@ class GuardPolicy:
             # heartbeat status (health.HealthMonitor.heartbeat_status)
             # is what escalates a numerically-dead run to the elastic
             # supervisor for a restart from the last good checkpoint.
-            return cls(nonfinite="skip_step")
+            return cls(nonfinite="skip_step",
+                       parity_drift="fallback_bf16",
+                       scale_collapse="fallback_bf16")
         return cls()
 
 

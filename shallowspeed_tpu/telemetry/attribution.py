@@ -47,6 +47,14 @@ import numpy as np
 
 from shallowspeed_tpu import flops as _flops
 
+# fp8-operand matmul FLOPs run the MXU at this multiple of the table/
+# calibrated rate (mirrors flops.device_peak_flops's fp8 branch — the
+# v7 spec's dense fp8 4.6PF vs bf16 2.3PF). Pricing the jaxpr's
+# float8-operand dots at 2x is what makes the attribution gate's
+# headline work: an fp8-on step's attrib_mxu_frac must SHRINK vs the
+# bf16 baseline because the same dots cost half the roofline seconds.
+FP8_FLOPS_RATIO = 2.0
+
 # ------------------------------------------------------- device rates
 
 _CALIBRATED: dict | None = None
@@ -148,6 +156,7 @@ def roofline_of_jaxpr(closed) -> dict:
     from shallowspeed_tpu.telemetry.collectives import _COLLECTIVES
 
     acc = {"flops_shard": 0, "flops_global": 0,
+           "flops_fp8_shard": 0, "flops_fp8_global": 0,
            "dot_bytes_shard": 0, "dot_bytes_global": 0,
            "bytes_shard": 0, "bytes_global": 0}
     state = {"approx": False}
@@ -204,6 +213,13 @@ def roofline_of_jaxpr(closed) -> dict:
             key = "shard" if in_shmap else "global"
             if fl:
                 out["flops_" + key] += fl * trips
+                # float8-operand dots are the quantized matmuls
+                # (ops/matmul.fp8_dense) — tracked as a subset so
+                # roofline_seconds can price them at FP8_FLOPS_RATIO
+                if any(str(getattr(v.aval, "dtype", "")
+                           ).startswith("float8")
+                       for v in eqn.invars):
+                    out["flops_fp8_" + key] += fl * trips
                 out["dot_bytes_" + key] += eqn_bytes(eqn) * trips
             else:
                 out["bytes_" + key] += eqn_bytes(eqn) * trips
@@ -226,7 +242,12 @@ def roofline_seconds(roof: dict, rates: dict,
     nd = max(1, int(n_devices))
     mxu = hbm = 0.0
     for key, div in (("shard", 1), ("global", nd)):
-        mxu += max(roof.get("flops_" + key, 0) / rates["flops"],
+        fl = roof.get("flops_" + key, 0)
+        fp8 = min(roof.get("flops_fp8_" + key, 0), fl)
+        # fp8-operand dots run at FP8_FLOPS_RATIO x the base rate
+        flop_s = ((fl - fp8) / rates["flops"]
+                  + fp8 / (rates["flops"] * FP8_FLOPS_RATIO))
+        mxu += max(flop_s,
                    roof.get("dot_bytes_" + key, 0) / rates["hbm"]) / div
         hbm += roof.get("bytes_" + key, 0) / rates["hbm"] / div
     return {"mxu_s": mxu, "hbm_s": hbm}

@@ -252,6 +252,7 @@ class Replica:
             "availability": st.get("availability"),
             "last_step": st.get("last_step"),
             "serving": st.get("serving"),
+            "numerics": st.get("numerics"),
             "alerts": st.get("alerts") or [],
             "quantiles": {name: {"count": sk.n,
                                  "p50": sk.quantile(50),
@@ -672,6 +673,9 @@ class FleetCollector:
         profiling = self._profiling_locked(names)
         if profiling:
             out["profiling"] = profiling
+        numerics = self._numerics_locked(names)
+        if numerics:
+            out["numerics"] = numerics
         if skipped:
             out["fleet"]["skipped_mixed_rel_err"] = skipped
         if self.flight is not None:
@@ -702,6 +706,40 @@ class FleetCollector:
                 ent["top_frame"] = top.rsplit(";", 1)[-1]
             per[names[rep.uid]] = ent
         return {"replicas": per} if per else None
+
+    def _numerics_locked(self, names: dict) -> dict | None:
+        """The fleet's numerics digest (round 18): worst shadow-parity
+        rel-err and overflow fraction across replicas (named, so the
+        bad replica is one read away) plus the roster of replicas the
+        guard already dropped to bf16 — "is the fp8 rollout healthy
+        fleet-wide" without opening N status pages."""
+        per = {}
+        for rep in self.replicas:
+            num = (rep._status or {}).get("numerics")
+            if isinstance(num, dict) and num:
+                per[names[rep.uid]] = num
+        if not per:
+            return None
+
+        def worst(field):
+            best = None
+            for name, num in per.items():
+                v = num.get(field)
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool) \
+                        and (best is None or v > best[1]):
+                    best = (name, v)
+            return ({"replica": best[0], "value": best[1]}
+                    if best else None)
+
+        return {
+            "replicas": per,
+            "worst_parity_loss_rel": worst("num_parity_loss_rel"),
+            "worst_overflow": worst("num_overflow_max"),
+            "fell_back_bf16": sorted(
+                name for name, num in per.items()
+                if num.get("num_precision") == "bf16"),
+        }
 
     def _slowest_request(self, names: dict) -> dict | None:
         worst = None
@@ -824,6 +862,15 @@ def format_fleet_status(status: dict) -> str:
             q = (rep.get("quantiles") or {}).get(metric)
             if q:
                 bits.append(f"{metric} p50 {q['p50']}")
+        num = rep.get("numerics") or {}
+        if num.get("num_precision"):
+            bits.append(f"precision {num['num_precision']}")
+            if num.get("num_parity_loss_rel") is not None:
+                bits.append(
+                    f"parity {num['num_parity_loss_rel']:.3g}")
+            if num.get("last_verdicts"):
+                bits.append(
+                    f"NUMERICS {','.join(num['last_verdicts'])}")
         if rep.get("error"):
             bits.append(f"error {rep['error']}")
         lines.append("  ".join(bits))
@@ -841,6 +888,22 @@ def format_fleet_status(status: dict) -> str:
     for e in status.get("worst_ttft") or []:
         lines.append(f"  worst ttft: {e['ttft_ms']} ms  "
                      f"request {e.get('id')} @ {e['replica']}")
+    num = status.get("numerics")
+    if num:
+        wp = num.get("worst_parity_loss_rel")
+        wo = num.get("worst_overflow")
+        bits = [f"numerics: {len(num.get('replicas') or {})} fp8 "
+                f"replica(s)"]
+        if wp:
+            bits.append(f"worst parity {wp['value']:.3g} "
+                        f"@ {wp['replica']}")
+        if wo:
+            bits.append(f"worst overflow {wo['value']:.3g} "
+                        f"@ {wo['replica']}")
+        lines.append("  " + "  ".join(bits))
+        if num.get("fell_back_bf16"):
+            lines.append(f"  FELL BACK to bf16: "
+                         f"{', '.join(num['fell_back_bf16'])}")
     return "\n".join(lines)
 
 

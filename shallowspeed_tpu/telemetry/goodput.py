@@ -46,8 +46,12 @@ import json
 
 # Pause kinds StepRates excludes from its throughput windows. Anything
 # else noted with seconds is treated as an in-window loss.
+# `shadow_parity` (schema v13) is the numerics observatory's frozen
+# master-precision oracle step — diagnostic compute, not training, so
+# its seconds are itemized as a named loss rather than counted
+# productive.
 EXCLUDED_KINDS = ("init", "restore", "val", "ckpt_save", "telemetry",
-                  "calibration", "pause")
+                  "calibration", "pause", "shadow_parity")
 
 
 class GoodputLedger:
@@ -343,6 +347,52 @@ def run_goodput(path, extra_paths=()) -> dict:
         # (telemetry/tracing.goodput_block; `recs` forwarded so the
         # primary log is parsed once, not twice)
         "tracing": _tracing_block([path, *extra_paths], recs),
+        # None without schema-v13 num_* step fields — the numerics
+        # observatory's run story: worst clamp fractions, the scale
+        # floor, shadow-parity extremes, verdicts fired, and whether
+        # the run ended on the bf16 fallback
+        "numerics": _numerics_block(recs),
+    }
+
+
+def _numerics_block(recs) -> dict | None:
+    """Reduce schema-v13 ``num_*`` step fields to the run's numerics
+    story. Worst-case reductions on purpose: the question --goodput
+    answers here is "did the quantized path ever misbehave", so a
+    single bad step must survive the reduction."""
+    steps = [r for r in recs if r.get("event") == "step"
+             and ("num_scale_min" in r or "num_precision" in r)]
+    if not steps:
+        return None
+
+    def worst(key, fn=max):
+        vals = [r[key] for r in steps
+                if isinstance(r.get(key), (int, float))]
+        return fn(vals) if vals else None
+
+    verdicts: dict[str, int] = {}
+    for r in steps:
+        for kind in (r.get("num_verdicts") or ()):
+            if isinstance(kind, str):
+                verdicts[kind] = verdicts.get(kind, 0) + 1
+    shadow = worst("num_shadow_total")
+    fellback = any(r.get("num_precision") == "bf16" for r in steps)
+    return {
+        "steps_observed": len(steps),
+        "steps_fp8": sum(1 for r in steps
+                         if r.get("num_precision") == "fp8"),
+        "overflow_max": worst("num_overflow_max"),
+        "underflow_max": worst("num_underflow_max"),
+        "scale_min": worst("num_scale_min", min),
+        "amax_max": worst("num_amax_max"),
+        "parity_loss_rel_max": worst("num_parity_loss_rel"),
+        "parity_grad_relmax_max": worst("num_parity_grad_relmax"),
+        "shadow_samples": int(shadow) if shadow is not None else 0,
+        "verdicts": verdicts,
+        "final_precision": (str(steps[-1]["num_precision"])
+                            if isinstance(steps[-1].get("num_precision"),
+                                          str) else None),
+        "fell_back_bf16": fellback,
     }
 
 
@@ -643,6 +693,26 @@ def format_report(rep: dict) -> str:
         if bad:
             lines.append(f"  WARNING: sketch/offline parity out of "
                          f"bound: {bad}")
+    num = rep.get("numerics")
+    if num:
+        def g(v):
+            return "—" if v is None else f"{v:.3g}"
+
+        lines.append(
+            f"numerics ({num.get('steps_fp8', num['steps_observed'])} "
+            f"fp8 / {num['steps_observed']} observed step(s), "
+            f"{num['shadow_samples']} shadow sample(s)): "
+            f"overflow max {g(num['overflow_max'])}  "
+            f"underflow max {g(num['underflow_max'])}  "
+            f"scale min {g(num['scale_min'])}  "
+            f"parity loss/grad {g(num['parity_loss_rel_max'])}/"
+            f"{g(num['parity_grad_relmax_max'])}")
+        if num["verdicts"] or num["fell_back_bf16"]:
+            lines.append(
+                f"  verdicts: {num['verdicts'] or '{}'}"
+                + (f"  FELL BACK to bf16 (final precision "
+                   f"{num['final_precision']})"
+                   if num["fell_back_bf16"] else ""))
     prof = rep.get("profiling")
     if prof and prof["samples"]:
         tot = prof["samples"]

@@ -243,8 +243,10 @@ def fetch_pack(pack) -> dict | None:
         if k in host:
             out[k] = int(host[k])
     # fp8 delayed-scaling bookkeeping (fp8.Fp8TrainEngine): per-layer
-    # activation absmax and the scale it produced
-    for k in ("fp8_amax", "fp8_scale"):
+    # activation absmax, the scale it produced, and (round 18) the
+    # clamp fractions at each quantize — the numerics pack the
+    # NumericsMonitor reduces host-side
+    for k in ("fp8_amax", "fp8_scale", "fp8_overflow", "fp8_underflow"):
         if k in host:
             out[k] = [float(v) for v in np.asarray(host[k]).ravel()]
     return out

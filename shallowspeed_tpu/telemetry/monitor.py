@@ -376,6 +376,10 @@ class Monitor:
         self.profiler = None
         self.last_profile: dict | None = None
         self.serving: dict = {}
+        # numerics observatory (round 18): last-seen schema-v13 num_*
+        # step fields — the live precision story /status.json and
+        # /metrics serve next to health, and the fleet view rolls up
+        self.numerics: dict = {}
         # per-request lifecycle accounting (round 16): in-flight
         # phase-time accumulation keyed by request id, reduced on
         # "finished" into the rq_* component sketches and the
@@ -457,6 +461,7 @@ class Monitor:
                 str(v) for v in verdicts), rec.get("step"), rec)
         elif rec.get("health_nonfinite"):
             self.health = "warn: nonfinite"
+        self._note_numerics(rec)
         if self.derive_steps:
             step, wall = rec.get("step"), rec.get("wall")
             if isinstance(rec.get("tokens_per_sec"), (int, float)):
@@ -575,6 +580,26 @@ class Monitor:
         if verdicts:
             self.health = "warn: " + ",".join(str(v) for v in verdicts)
             self._flight_dump("anomaly:" + ",".join(
+                str(v) for v in verdicts), rec.get("step"), rec)
+        self._note_numerics(rec)
+
+    def _note_numerics(self, rec: dict) -> None:
+        """Fold schema-v13 num_* step fields into the live numerics
+        view; a numerics verdict (scale_collapse / parity_drift) trips
+        the same incident path as a health verdict — flight dump +
+        profiler capture window."""
+        for field in ("num_overflow_max", "num_underflow_max",
+                      "num_scale_min", "num_amax_max", "num_drift_z",
+                      "num_osc", "num_parity_loss_rel",
+                      "num_parity_grad_relmax", "num_shadow_total",
+                      "num_precision"):
+            if field in rec and rec[field] is not None:
+                self.numerics[field] = rec[field]
+        verdicts = rec.get("num_verdicts")
+        if verdicts:
+            self.numerics["last_verdicts"] = [str(v) for v in verdicts]
+            self.health = "warn: " + ",".join(str(v) for v in verdicts)
+            self._flight_dump("numerics:" + ",".join(
                 str(v) for v in verdicts), rec.get("step"), rec)
 
     def _on_profile(self, rec: dict) -> None:
@@ -776,6 +801,10 @@ class Monitor:
                 "health": self.health,
                 "last_step": self.last_step,
                 "serving": self.serving or None,
+                # the numerics observatory's last-seen story (schema
+                # v13): live precision, clamp fractions, shadow-parity
+                # rel-errs, and the last verdicts that fired
+                "numerics": self.numerics or None,
                 # the slowest finished request's per-component
                 # decomposition (round 16) — where ITS latency went,
                 # one hop from the burning quantile
@@ -823,6 +852,20 @@ class Monitor:
                 if isinstance(v, (int, float)):
                     lines.append(f"# TYPE {P}{field} gauge")
                     lines.append(f"{P}{field} {v:.6g}")
+            for field in ("num_overflow_max", "num_underflow_max",
+                          "num_scale_min", "num_amax_max",
+                          "num_parity_loss_rel",
+                          "num_parity_grad_relmax"):
+                v = self.numerics.get(field)
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    lines.append(f"# TYPE {P}{field} gauge")
+                    lines.append(f"{P}{field} {v:.6g}")
+            if self.numerics.get("num_precision") in ("fp8", "bf16"):
+                lines.append(f"# TYPE {P}num_precision_fp8 gauge")
+                lines.append(
+                    f"{P}num_precision_fp8 "
+                    f"{1 if self.numerics['num_precision'] == 'fp8' else 0}")
             if self.last_step and isinstance(
                     self.last_step.get("step"), int):
                 lines.append(f"# TYPE {P}last_step gauge")

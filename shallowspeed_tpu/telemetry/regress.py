@@ -53,6 +53,13 @@ METRICS = {
     # polling/scoring — a drop here with serving_tok_per_sec flat
     # means routing overhead grew; rounds before r15 pass vacuously
     "fleet_tok_per_sec": (0.35, None),
+    # fp8 attribution gate (round 18, bench.py bench_fp8): ratio of
+    # the bf16 baseline's attrib_mxu_frac to the fp8-on case's — the
+    # quantized-dot pricing must keep it > 1. Mostly deterministic
+    # (jaxpr-derived rooflines; the calibrated flops/hbm rate ratio
+    # moves it a little per host), so a tight floor; rounds before
+    # r18 lack the metric and pass vacuously
+    "fp8_mxu_shrink": (0.10, None),
 }
 
 

@@ -111,12 +111,25 @@ from pathlib import Path
 # sketch snapshots: the LAST event per process stanza is that
 # stanza's whole story, and `python -m shallowspeed_tpu.telemetry
 # --profile <log> --out flame.json` reduces them to a flamegraph.
+# 13 = v12 plus the numerics-observatory extension (round 18,
+# `telemetry/numerics.py` + the fp8 numerics pack): `num_*` step
+# fields — per-step worst clamp fractions (num_overflow_max /
+# num_underflow_max), the live delayed-scale extrema (num_scale_min /
+# num_amax_max), the RobustEWMA scale-drift z and sign-flip
+# oscillation score (num_drift_z / num_osc), the latest shadow-parity
+# sample vs the frozen master-precision oracle (num_parity_loss_rel /
+# num_parity_grad_relmax) with its cumulative sample count
+# (num_shadow_total), the live compute precision (num_precision:
+# "fp8" | "bf16" — flips when the guard takes the bf16 fallback), and
+# num_verdicts (the drained scale_collapse / parity_drift window,
+# mirroring health_verdicts); "ledger" lines allow the
+# `shadow_parity` kind's seconds (goodput-excluded oracle steps).
 # The validator accepts ALL dialects — every versioned field is
-# optional, so committed v1-v11 artifacts (no version stamp / no
+# optional, so committed v1-v12 artifacts (no version stamp / no
 # health / overlap / attrib / wall / fault / request / monitor /
-# straggler / lifecycle / speculation / routing / tracing / profile
-# fields) keep validating unchanged.
-SCHEMA_VERSION = 12
+# straggler / lifecycle / speculation / routing / tracing / profile /
+# numerics fields) keep validating unchanged.
+SCHEMA_VERSION = 13
 
 _NUM = (int, float)
 
@@ -280,6 +293,15 @@ _STEP_TELEMETRY = {
     "attrib_host_frac": _NUM, "attrib_unexplained_frac": _NUM,
     "attrib_t_step_ms": _NUM, "attrib_rates_source": str,
     "attrib_compute_scale": _NUM,
+    # --- schema v13: numerics-observatory fields (telemetry/
+    # numerics.py) — the fp8 pack's host-side reduction + the
+    # shadow-parity series vs the frozen master-precision oracle
+    "num_overflow_max": _NUM, "num_underflow_max": _NUM,
+    "num_scale_min": _NUM, "num_amax_max": _NUM,
+    "num_drift_z": _NUM, "num_osc": _NUM,
+    "num_parity_loss_rel": _NUM, "num_parity_grad_relmax": _NUM,
+    "num_shadow_total": int, "num_precision": str,
+    "num_verdicts": list,
 }
 
 # "M" (schema v8): Chrome metadata events — the named per-request

@@ -39,9 +39,14 @@ def parse_args(argv=None):
     p.add_argument("--schedule", type=str,
                    choices=["pipedream", "gpipe", "naive"], default="naive")
     p.add_argument("--engine", type=str,
-                   choices=["auto", "vm", "fused", "spmd"], default="auto",
+                   choices=["auto", "vm", "fused", "spmd", "fp8"],
+                   default="auto",
                    help="auto: fused for pp=1, spmd (compiled GPipe) for "
-                        "pp>1 with --schedule gpipe, else the instruction VM")
+                        "pp>1 with --schedule gpipe, else the instruction "
+                        "VM. fp8: the single-device fp8-e4m3 trainer "
+                        "(shallowspeed_tpu.fp8) under the numerics "
+                        "observatory — per-step numerics pack, shadow-"
+                        "parity sampling, guard-driven bf16 fallback")
     p.add_argument("--epochs", type=int, default=EPOCHS)
     p.add_argument("--batch-size", type=int, default=GLOBAL_BATCH_SIZE)
     p.add_argument("--mubatches", type=int, default=N_MUBATCHES)
@@ -149,6 +154,18 @@ def parse_args(argv=None):
                         "non-finite gradients bit-identically. "
                         "Disables the fused whole-epoch dispatch (the "
                         "pack rides the per-batch step)")
+    p.add_argument("--shadow-every", type=int, default=16,
+                   help="--engine fp8: run the frozen master-precision "
+                        "oracle step on the live batch every N training "
+                        "steps (0 = off) and gate the loss/grad parity "
+                        "against the numerics envelopes; the oracle "
+                        "seconds are ledger-excluded as shadow_parity. "
+                        "Step 0 is always skipped — the delayed amax "
+                        "history has not warmed and its parity is "
+                        "legitimately loose")
+    p.add_argument("--log-every", type=int, default=10,
+                   help="--engine fp8: step-line cadence (schema v13 "
+                        "num_* fields ride each line)")
     p.add_argument("--platform", type=str, default=None,
                    choices=["cpu", "tpu"],
                    help="force a JAX platform (this environment pins "
@@ -278,6 +295,188 @@ def compute_accuracy(engine, val_ds) -> float:
     return correct / total
 
 
+def train_fp8(args) -> float:
+    """The numerics-observatory driver (round 18): a STEP-based loop
+    over the fp8-e4m3 trainer (`shallowspeed_tpu.fp8`) whose every
+    line carries the runtime precision telemetry — the per-layer
+    clamp/scale pack reduced by `telemetry.numerics.NumericsMonitor`,
+    shadow-parity samples against the frozen f32 oracle every
+    `--shadow-every` steps, and the guard escalation those verdicts
+    drive (warn -> fallback_bf16 -> abort). Returns the final
+    validation loss (the MSE head has no argmax accuracy story worth
+    reporting next to the parity numbers)."""
+    import jax  # noqa: F401  (backend init before any engine build)
+
+    from shallowspeed_tpu import chaos
+    from shallowspeed_tpu.data.dataset import Dataset
+    from shallowspeed_tpu.data.mnist import ensure_mnist
+    from shallowspeed_tpu.elastic import install_sigterm_exit
+    from shallowspeed_tpu.fp8 import Fp8TrainEngine
+    from shallowspeed_tpu.metrics import MetricsLogger, StepRates
+    from shallowspeed_tpu.optim import OPTIMIZERS
+    from shallowspeed_tpu.telemetry import profiler as profiler_mod
+    from shallowspeed_tpu.telemetry.anomaly import GuardPolicy
+    from shallowspeed_tpu.telemetry.goodput import GoodputLedger
+    from shallowspeed_tpu.telemetry.health import HealthMonitor
+    from shallowspeed_tpu.telemetry.monitor import close_monitor, from_args
+    from shallowspeed_tpu.telemetry.numerics import NumericsMonitor
+    from shallowspeed_tpu.utils import rprint
+
+    for flag, val in (("--dp", args.dp != 1), ("--pp", args.pp != 1),
+                      ("--save-dir", bool(args.save_dir)),
+                      ("--telemetry", args.telemetry != "off"),
+                      ("--overlap", args.overlap != "off")):
+        if val:
+            raise SystemExit(
+                f"--engine fp8 is the single-device numerics trainer; "
+                f"{flag} is not supported with it")
+    install_sigterm_exit()
+    chaos.setup(args.chaos, seed=args.chaos_seed,
+                state_dir=args.chaos_state or None,
+                log_file=args.log_file or None)
+    t_proc0 = time.time()
+    opt_kw = {"grad_clip": args.grad_clip or None}
+    if args.optimizer == "adamw":
+        opt_kw["weight_decay"] = args.weight_decay
+    optimizer = OPTIMIZERS[args.optimizer](lr=args.lr, **opt_kw)
+    engine = Fp8TrainEngine(LAYER_SIZES, optimizer)
+
+    data_dir = ensure_mnist(Path(args.data_dir))
+    train_ds = Dataset(data_dir, args.batch_size,
+                       args.batch_size).load(0, 1)
+    val_ds = Dataset(data_dir, args.batch_size, args.batch_size,
+                     validation=True).load(0, 1)
+    n_batches = train_ds.get_num_batches()
+    if args.max_batches:
+        n_batches = min(n_batches, args.max_batches)
+    total_steps = n_batches * args.epochs
+
+    metrics = MetricsLogger(
+        args.log_file, engine=type(engine).__name__, dp=1, pp=1,
+        schedule="fp8", batch_size=args.batch_size,
+        **({"replica": args.replica} if args.replica else {}))
+    ledger = GoodputLedger(metrics)
+    live_mon, live_srv = from_args(args, metrics)
+    if live_mon is not None:
+        chaos.add_observer(live_mon.note_line)
+    plane = profiler_mod.from_args(args, metrics)
+    if plane is not None:
+        chaos.add_observer(plane.on_fault)
+        if live_mon is not None:
+            live_mon.profiler = plane
+            live_mon.alert_listeners.append(plane.on_alert)
+
+    # the observatory's two host-side reducers: the numerics monitor
+    # is ALWAYS on for this engine (it is the point of the driver);
+    # grad-health verdicts join it under --health
+    policy = GuardPolicy.for_mode(args.health) \
+        if args.health != "off" else None
+    num_mon = NumericsMonitor(policy=policy)
+    monitor = HealthMonitor(policy=policy) \
+        if args.health != "off" else None
+    guarded = args.health == "guard"
+
+    def val_loss() -> float:
+        t0 = time.time()
+        tot = 0.0
+        nb = val_ds.get_num_batches()
+        for b in range(nb):
+            tot += engine.eval_loss(val_ds.load_micro_batch_input(b, 0),
+                                    val_ds.load_micro_batch_target(b, 0))
+        rates.pause(time.time() - t0, kind="val")
+        return tot / max(nb, 1)
+
+    rates = StepRates(args.batch_size, health=monitor, numerics=num_mon,
+                      ledger=ledger, monitor=live_mon)
+    ledger.note("init", seconds=time.time() - t_proc0)
+    last_logged = -1
+    loss = float("nan")
+    try:
+        for step in range(total_steps):
+            # step faults (incl. scale_poison@N) fire per training
+            # STEP on this driver — its cadence is the step, not the
+            # epoch
+            chaos.on_step(step, engine)
+            batch_id = step % n_batches
+            x = train_ds.load_micro_batch_input(batch_id, 0)
+            y = train_ds.load_micro_batch_target(batch_id, 0)
+            loss = engine.train_batch(x, y)
+            # the pack fetch is one tiny host sync per step — this
+            # engine's contract is observability, and the collapse
+            # signature (a poisoned scale self-heals as fresh amaxes
+            # roll in) is only visible AT the poisoned step
+            verdicts = num_mon.observe(step, engine.health_snapshot())
+            if (args.shadow_every and step
+                    and step % args.shadow_every == 0):
+                t_sh = time.time()
+                parity = engine.shadow_parity(x, y)
+                rates.pause(time.time() - t_sh, kind="shadow_parity")
+                verdicts += num_mon.note_parity(step, parity)
+            if monitor is not None:
+                verdicts += monitor.observe(step, loss,
+                                            engine.health_snapshot())
+            fatal = []
+            for v in verdicts:
+                rprint(str(v))
+                if v.action == "fallback_bf16" and guarded \
+                        and engine.precision == "fp8":
+                    engine.fallback_bf16()
+                    num_mon.note_fallback()
+                    ledger.note("fp8_fallback", count=1)
+                    rprint(f"numerics guard: falling back to the bf16 "
+                           f"master-precision step at step {step} "
+                           f"({v.kind})")
+                elif v.action == "abort" and guarded:
+                    fatal.append(v)
+            at_end = step == total_steps - 1
+            if verdicts or at_end or step - last_logged >= args.log_every:
+                r = rates.log_point(step - last_logged)
+                last_logged = step
+                metrics.log(event="step", step=step,
+                            loss=round(float(loss), 6),
+                            tokens_per_sec=round(r.pop(
+                                "tokens_per_sec"), 1),
+                            tokens_per_sec_cum=round(r.pop(
+                                "tokens_per_sec_cum"), 1), **r)
+                rprint(f"step {step:5d}  loss {loss:.5f}  "
+                       f"precision {engine.precision}"
+                       + (f"  parity "
+                          f"{num_mon._last_parity['loss_rel']:.3g}"
+                          if num_mon._last_parity else ""))
+                if args.heartbeat_file and not chaos.heartbeat_frozen():
+                    from shallowspeed_tpu.elastic import write_heartbeat
+
+                    write_heartbeat(args.heartbeat_file,
+                                    monitor.heartbeat_status()
+                                    if monitor is not None else "ok")
+            if fatal:
+                if live_mon is not None:
+                    live_mon.flight_dump(
+                        "numerics:" + ",".join(v.kind for v in fatal),
+                        step=step, trigger=[str(v) for v in fatal])
+                raise SystemExit(
+                    f"numerics policy abort at step {step}: "
+                    + "; ".join(v.detail for v in fatal))
+        final = val_loss()
+        rprint(f"final val loss {final:.5f}  precision "
+               f"{engine.precision}  shadow samples "
+               f"{num_mon.shadow_total}")
+        metrics.log(event="val", step=max(total_steps - 1, 0),
+                    val_loss=round(final, 6))
+        return final
+    finally:
+        if plane is not None:
+            chaos.remove_observer(plane.on_fault)
+            plane.close()
+        if live_mon is not None:
+            chaos.remove_observer(live_mon.note_line)
+            close_monitor(live_mon, live_srv)
+        plan = chaos.active()
+        if plan is not None and plan.unfired():
+            rprint(f"chaos: scheduled fault(s) never fired: "
+                   f"{', '.join(plan.unfired())}")
+
+
 def train(args) -> float:
     import jax
 
@@ -288,6 +487,9 @@ def train(args) -> float:
     from shallowspeed_tpu.parallel.schedules import (
         GPipeSchedule, NaiveParallelSchedule, PipeDreamSchedule)
     from shallowspeed_tpu.utils import assert_replicas_in_sync, get_model_hash, rprint
+
+    if args.engine == "fp8":
+        return train_fp8(args)
 
     schedule_cls = {
         "naive": NaiveParallelSchedule,
