@@ -939,6 +939,149 @@ def bench_fleet() -> dict:
         return {"fleet_error": repr(e)[:200]}
 
 
+def bench_prefix() -> dict:
+    """Shared-prompt prefix-caching sweep (round 19,
+    `serving/cache.PrefixIndex` + sticky routing). Three measurements:
+    (1) walker-measured prefill FLOPs — with `prefill_chunk ==
+    block_size` the chunk-call count maps 1:1 to blocks prefilled, so
+    pricing one chunk's jaxpr (`roofline_of_jaxpr`) and counting chunk
+    calls gives the exact prefill FLOPs a fully-shared prompt pays
+    cold vs on a cache hit (the hit must drop to the copied TAIL block
+    only); (2) stream parity — the prefix-on engine must emit
+    token-identical streams to the prefix-OFF oracle over a mixed
+    greedy/sampled shared-prompt batch; (3) the 2-replica sticky
+    on/off fleet sweep — same shared-prefix request mix, sticky
+    routing on vs off (prefix caching ON in both fleets), recording
+    fleet-edge ttft p50 per level. Headline `prefix_tok_per_sec`
+    (best sticky-on level) joins the `--regress` noise-band gate.
+    Never raises — a failure lands as prefix_error in the JSON
+    line."""
+    import jax
+
+    from shallowspeed_tpu.models import transformer as T
+    from shallowspeed_tpu.serving import ServingEngine
+    from shallowspeed_tpu.serving.cache import blocks_for
+    from shallowspeed_tpu.serving.engine import _prefill_chunk, table_width
+    from shallowspeed_tpu.serving.router import InProcessReplica, Router
+    from shallowspeed_tpu.telemetry.attribution import roofline_of_jaxpr
+
+    try:
+        cfg = T.TransformerConfig(vocab=128, d_model=64, n_heads=4,
+                                  n_layers=2, max_seq=256)
+        params = jax.device_put(T.init(cfg, seed=0))
+        bs = 16                      # block_size == prefill_chunk
+        shared_len, tail_len, max_new = 96, 9, 8
+        n_fam = 8                    # distinct shared preambles
+
+        def family(f):
+            return np.random.default_rng([19, f]).integers(
+                0, cfg.vocab, shared_len).astype(np.int32)
+
+        def prompt(f, i):
+            tail = np.random.default_rng([23, f, i]).integers(
+                0, cfg.vocab, tail_len).astype(np.int32)
+            return np.concatenate([family(f), tail])
+
+        def build(prefix):
+            return ServingEngine(params, cfg, n_blocks=96,
+                                 block_size=bs, max_slots=8,
+                                 prefill_chunk=bs, prefix_cache=prefix)
+
+        # (1) FLOPs per prefill chunk, priced off the traced program
+        nb = blocks_for(shared_len + tail_len + max_new - 1, bs)
+        w = table_width(nb, 4)
+        pools = build(False).pools
+        roof = roofline_of_jaxpr(jax.make_jaxpr(
+            lambda *a: _prefill_chunk(*a, cfg=cfg))(
+                params, pools, np.zeros((1, bs), np.int32), np.int32(0),
+                np.int32(bs), np.full((1, w), 0, np.int32), np.int32(0),
+                np.int32(0)))
+        chunk_flops = int(roof["flops_shard"] + roof["flops_global"])
+        # a fully-shared (block-aligned) prompt: cold pays every block,
+        # the hit re-prefills only the copied tail block
+        eng = build(True)
+        full = family(0)                         # 96 tokens, 6 blocks
+        eng.submit(full, max_new, rid="cold")
+        eng.run()
+        chunks_cold = eng.counters["prefill_chunks"]
+        eng.submit(full, max_new, seed=1, rid="hit")
+        eng.run()
+        chunks_hit = eng.counters["prefill_chunks"] - chunks_cold
+
+        # (2) parity: prefix-on streams vs the prefix-OFF oracle over
+        # a mixed greedy/sampled shared-prompt batch
+        def serve(prefix):
+            e = build(prefix)
+            for i in range(12):
+                e.submit(prompt(i % n_fam, i // n_fam), max_new,
+                         temperature=0.8 if i % 2 else 0.0, seed=i,
+                         rid=f"p{i}")
+            return e.run(), e
+        got, eng_on = serve(True)
+        ref, _ = serve(False)
+        parity = all(np.array_equal(ref[k], got[k]) for k in ref)
+
+        # (3) sticky on/off fleet sweep: 2 replicas, prefix caching ON
+        # in both — only the routing differs. Arrivals come in WAVES
+        # (one request per family per wave, drained between waves) —
+        # the recurring shared-prompt traffic the cache targets:
+        # donation happens at finish, so a family's later arrivals can
+        # only hit where its earlier ones already completed. Sticky
+        # keeps each family on its home replica (one cold prefill per
+        # family fleet-wide); load-only routing re-pays the cold
+        # prefill wherever the family lands next. The per-wave family
+        # order ROTATES — with a fixed order the load tie-break is
+        # deterministic and re-lands every family on the same replica
+        # each wave, silently handing the off-mode full cache affinity
+        # too.
+        def offer(sticky, waves):
+            router = Router(
+                lambda name: InProcessReplica(name,
+                                              lambda nm: build(True)),
+                n_replicas=2, request_timeout=120.0,
+                sticky=sticky, sticky_block=bs)
+            t0 = time.perf_counter()
+            for w in range(waves):
+                for k in range(n_fam):
+                    f = (k + w) % n_fam
+                    router.submit(prompt(f, w), max_new,
+                                  rid=f"s{waves}_{w}_{f}")
+                router.run(max_wall=300.0)
+            wall = time.perf_counter() - t0
+            toks = sum(r["tokens_out"] for r in router.records
+                       if r["status"] == "done")
+            ttfts = [r["ttft_ms"] for r in router.records
+                     if "ttft_ms" in r]
+            return {"offered": waves * n_fam, "wall_s": round(wall, 3),
+                    "tok_per_sec": round(toks / wall, 2),
+                    "ttft_p50_ms": round(float(np.median(ttfts)), 2)
+                    if ttfts else None}
+
+        offer(True, 1)               # compile warmup (excluded)
+        on_levels = [offer(True, n) for n in (2, 3)]
+        off_levels = [offer(False, n) for n in (2, 3)]
+        return {"prefix_case": {
+                    "chunk_flops": chunk_flops,
+                    "prefill_flops_cold": chunk_flops * chunks_cold,
+                    "prefill_flops_hit": chunk_flops * chunks_hit,
+                    "chunks_cold": chunks_cold,
+                    "chunks_hit": chunks_hit,
+                    "parity": bool(parity),
+                    "skipped_tokens": int(
+                        eng_on.counters["prefix_skipped_tokens"]),
+                    "sticky_on": on_levels, "sticky_off": off_levels,
+                    "block_size": bs, "families": n_fam,
+                    "shared_len": shared_len},
+                "prefix_tok_per_sec": max(lv["tok_per_sec"]
+                                          for lv in on_levels),
+                "prefix_sticky_ttft_p50_ms": min(
+                    lv["ttft_p50_ms"] for lv in on_levels),
+                "prefix_nosticky_ttft_p50_ms": min(
+                    lv["ttft_p50_ms"] for lv in off_levels)}
+    except Exception as e:  # pragma: no cover — keep the headline robust
+        return {"prefix_error": repr(e)[:200]}
+
+
 def bench_profile_overhead(rounds: int = 5) -> dict:
     """Profiler-on vs profiler-off serving throughput, INTERLEAVED
     (round 17, telemetry/profiler): each round serves the identical
@@ -1066,6 +1209,7 @@ def main():
     out.update(bench_fp8())
     out.update(bench_serving())
     out.update(bench_fleet())
+    out.update(bench_prefix())
     print(json.dumps(out))
 
 

@@ -73,6 +73,15 @@ def parse_args(argv=None):
     s.add_argument("--block-size", type=int, default=16)
     s.add_argument("--slots", type=int, default=4)
     s.add_argument("--prefill-chunk", type=int, default=64)
+    s.add_argument("--prefix-cache", default="on",
+                   choices=["off", "on"],
+                   help="prefix caching on every replica (serve.py "
+                        "--prefix-cache) AND sticky prefix-affinity "
+                        "routing router-side: prompts fingerprint by "
+                        "their leading aligned chunks and a replica "
+                        "that already served a prefix earns a bounded "
+                        "dispatch bonus, so shared-prompt traffic "
+                        "lands where its KV blocks already live")
     s.add_argument("--replica-args", default="",
                    help="extra raw serve.py args appended to every "
                         "replica's command (shlex-split), e.g. "
@@ -214,7 +223,8 @@ def main(argv=None) -> int:
                   "--n-blocks", str(args.n_blocks),
                   "--block-size", str(args.block_size),
                   "--slots", str(args.slots),
-                  "--prefill-chunk", str(args.prefill_chunk)]
+                  "--prefill-chunk", str(args.prefill_chunk),
+                  "--prefix-cache", args.prefix_cache]
     if args.rope:
         model_args.append("--rope")
     if args.ckpt:
@@ -267,7 +277,9 @@ def main(argv=None) -> int:
         autoscale=args.autoscale, min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
         scale_hold_s=args.scale_hold, idle_drain_s=args.idle_drain,
-        scale_cooldown_s=args.scale_cooldown)
+        scale_cooldown_s=args.scale_cooldown,
+        sticky=(args.prefix_cache == "on"),
+        sticky_block=args.block_size)
 
     t0 = time.time()
     i = 0

@@ -81,6 +81,14 @@ def parse_args(argv=None):
                    help="longest n-gram the draft proposer matches")
     s.add_argument("--top-k", type=int, default=0)
     s.add_argument("--top-p", type=float, default=0.0)
+    s.add_argument("--prefix-cache", default="on",
+                   choices=["off", "on"],
+                   help="content-addressed prefix caching: requests "
+                        "sharing block-aligned prompt prefixes map the "
+                        "shared KV blocks straight into their tables "
+                        "(refcounted, copy-on-write at the tail) and "
+                        "skip that prefill. Streams are token-identical "
+                        "to off — off is the parity oracle bench uses")
     p.add_argument("--requests", default="-",
                    help="JSONL request file, or - for stdin (ignored "
                         "under --serve unless explicitly set)")
@@ -239,7 +247,8 @@ def main(argv=None) -> int:
                     slots=args.slots, prefill_chunk=args.prefill_chunk,
                     kv_quant=args.kv_quant,
                     weight_quant=args.weight_quant,
-                    attn_impl=args.attn_impl, spec_k=args.spec_k)
+                    attn_impl=args.attn_impl, spec_k=args.spec_k,
+                    prefix_cache=args.prefix_cache)
     if args.replica:
         run_info["replica"] = args.replica
     metrics = MetricsLogger(args.log_file, **run_info)
@@ -259,7 +268,8 @@ def main(argv=None) -> int:
         weight_quant=args.weight_quant, attn_impl=args.attn_impl,
         spec_k=args.spec_k, spec_ngram=args.spec_ngram,
         top_k=args.top_k, top_p=args.top_p, metrics=metrics,
-        log_every=args.log_every)
+        log_every=args.log_every,
+        prefix_cache=(args.prefix_cache == "on"))
 
     # live telemetry plane: /status.json + /metrics endpoint, SLO
     # burn-rate alerts (optionally shedding load via Engine.on_alert),

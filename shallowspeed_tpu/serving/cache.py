@@ -28,7 +28,10 @@ sweep halves its bytes the same way.
 
 from __future__ import annotations
 
+import hashlib
+
 import jax.numpy as jnp
+import numpy as np
 
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.models.kv_cache import KV_QUANT_MODES, quantize_kv
@@ -75,15 +78,27 @@ def init_block_pool(cfg: T.TransformerConfig, n_blocks: int,
 
 
 class BlockAllocator:
-    """Host-side free list over one pool's block ids.
+    """Host-side refcounted free list over one pool's block ids.
 
-    Pure bookkeeping — no device arrays. Invariants (pinned in
-    tests/test_serving.py): a block is owned by at most one holder;
-    `free` rejects ids not currently allocated; at drain
-    `n_free == n_usable` (alloc and free balance); block 0 (scratch)
-    is never handed out."""
+    Pure bookkeeping — no device arrays. Every live block carries a
+    refcount: `alloc` mints fresh blocks at refcount 1, `acquire` adds
+    a reference to a block another holder already owns (prefix-cache
+    sharing), `release`/`free` drops one reference per listed id. A
+    block whose refcount hits zero returns to the free list — unless a
+    `PrefixIndex` still remembers its content, in which case it parks
+    on the COLD list (LRU-ordered, oldest first) where it stays
+    matchable until pool pressure reclaims it: `alloc` drains cold
+    blocks (dropping their index entries) before `OutOfBlocks` fires.
 
-    def __init__(self, n_blocks: int):
+    Invariants (pinned in tests/test_serving.py):
+    `n_free + n_live + n_cold == n_usable` always; refcounts are
+    per-holder, so at drain `n_live == 0`; `release` rejects ids whose
+    listed multiplicity exceeds the current refcount — including
+    duplicates WITHIN one call (`free([i, i])` of a once-held block
+    raises instead of double-appending `i` to the free list); block 0
+    (scratch) is never handed out."""
+
+    def __init__(self, n_blocks: int, index: "PrefixIndex | None" = None):
         if n_blocks < 2:
             raise ValueError(f"n_blocks={n_blocks} leaves no usable "
                              f"blocks past the reserved scratch block")
@@ -91,7 +106,12 @@ class BlockAllocator:
         # LIFO free list: recently freed (still-warm) blocks are reused
         # first; ids 1..n-1 — block 0 is the scratch sink
         self._free = list(range(self.n_blocks - 1, 0, -1))
-        self._allocated: set[int] = set()
+        self._ref: dict[int, int] = {}
+        # insertion-ordered dict as the LRU cold list: front = oldest
+        # (first reclaimed), back = most recently parked
+        self._cold: dict[int, None] = {}
+        self.index = index
+        self.cold_reclaims = 0
 
     @property
     def n_usable(self) -> int:
@@ -102,29 +122,164 @@ class BlockAllocator:
         return len(self._free)
 
     @property
-    def n_allocated(self) -> int:
-        return len(self._allocated)
+    def n_live(self) -> int:
+        return len(self._ref)
+
+    # back-compat alias (pre-refcount callers/tests)
+    n_allocated = n_live
+
+    @property
+    def n_cold(self) -> int:
+        return len(self._cold)
+
+    def refcount(self, bid: int) -> int:
+        return self._ref.get(bid, 0)
 
     def alloc(self, n: int) -> list[int]:
-        """Pop `n` blocks off the free list, or raise OutOfBlocks
+        """Mint `n` fresh blocks at refcount 1, or raise OutOfBlocks
         WITHOUT partial allocation (all-or-nothing, so a failed
-        admission never leaks)."""
+        admission never leaks). Under pressure, cold cached blocks are
+        reclaimed LRU-first (their index entries dropped) before the
+        raise — referenced blocks are never touched."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
-            raise OutOfBlocks(f"need {n} blocks, {len(self._free)} free")
+        if n > len(self._free) + len(self._cold):
+            raise OutOfBlocks(
+                f"need {n} blocks, {len(self._free)} free + "
+                f"{len(self._cold)} cold")
+        while len(self._free) < n:
+            self._reclaim_one()
         ids = [self._free.pop() for _ in range(n)]
-        self._allocated.update(ids)
+        for i in ids:
+            self._ref[i] = 1
         return ids
 
-    def free(self, ids) -> None:
+    def _reclaim_one(self) -> None:
+        bid = next(iter(self._cold))          # oldest parked = LRU
+        del self._cold[bid]
+        if self.index is not None:
+            self.index.drop_block(bid)
+        self._free.append(bid)
+        self.cold_reclaims += 1
+
+    def acquire(self, ids) -> None:
+        """Add one reference per listed id to blocks that are live or
+        cold (prefix-cache hit). Cold blocks are revived off the LRU
+        list. All-or-nothing: validates before mutating."""
         ids = list(ids)
-        bad = [i for i in ids if i not in self._allocated]
+        bad = [i for i in ids if i not in self._ref and i not in self._cold]
         if bad:
-            raise ValueError(f"free() of unallocated block(s) {bad}")
+            raise ValueError(f"acquire() of unknown block(s) {bad}")
         for i in ids:
-            self._allocated.discard(i)
-            self._free.append(i)
+            self._cold.pop(i, None)
+            self._ref[i] = self._ref.get(i, 0) + 1
+
+    def release(self, ids) -> None:
+        """Drop one reference per listed id. At refcount zero the block
+        parks cold if the index still maps its content, else returns to
+        the free list. Rejects (before any mutation) ids whose listed
+        multiplicity exceeds the current refcount — the duplicate-id
+        double-free of old `free([i, i])` raises here."""
+        ids = list(ids)
+        counts: dict[int, int] = {}
+        for i in ids:
+            counts[i] = counts.get(i, 0) + 1
+        bad = [i for i, c in counts.items() if self._ref.get(i, 0) < c]
+        if bad:
+            raise ValueError(
+                f"release() of unallocated/over-released block(s) "
+                f"{sorted(bad)}")
+        for i in ids:
+            self._ref[i] -= 1
+            if self._ref[i] == 0:
+                del self._ref[i]
+                if self.index is not None and self.index.has_block(i):
+                    self._cold[i] = None      # park: most-recent at back
+                else:
+                    self._free.append(i)
+
+    # `free` kept as the historical name for dropping ownership
+    free = release
+
+
+def chunk_hashes(tokens, block_size: int) -> list[bytes]:
+    """Chained content hashes of the FULL block-aligned chunks of
+    `tokens`: hash k = blake2b(hash k-1 || tokens[k*bs:(k+1)*bs]), so a
+    chunk's hash pins the entire prefix through it — two prompts share
+    hash k iff their first (k+1)*bs tokens are identical. The partial
+    tail (len % bs != 0 remainder) is never hashed: prefix hits are
+    granular to whole blocks. Shared by the engine-side `PrefixIndex`
+    and the router's sticky-affinity fingerprints so both sides agree
+    on chunk identity. blake2b-128 keyed by content, not Python
+    `hash()` — stable across processes and collision-safe at fleet
+    scale."""
+    toks = np.asarray(tokens, dtype=np.int64)
+    bs = int(block_size)
+    out: list[bytes] = []
+    h = b""
+    for k in range(len(toks) // bs):
+        h = hashlib.blake2b(h + toks[k * bs:(k + 1) * bs].tobytes(),
+                            digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class PrefixIndex:
+    """Content-addressed map from chained chunk hashes to block ids.
+
+    `match(tokens)` walks the chain front-to-back and returns the block
+    ids of the longest indexed aligned prefix (stops at the first
+    miss). `insert` registers a finished request's sealed prefix blocks
+    first-writer-wins: a chunk hash already mapped keeps its existing
+    block (the duplicate block stays unindexed and frees normally), so
+    one content never aliases two blocks. `drop_block` is the
+    allocator's cold-reclaim hook — dropping a parent makes every
+    descendant chain-unreachable via `match` even though the child
+    entries linger until their own reclaim (harmless: match walks
+    parent-first)."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._blocks: dict[bytes, int] = {}    # chain hash -> block id
+        self._hash_of: dict[int, bytes] = {}   # block id -> chain hash
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    def has_block(self, bid: int) -> bool:
+        return bid in self._hash_of
+
+    def match(self, tokens) -> list[int]:
+        ids: list[int] = []
+        for h in chunk_hashes(tokens, self.block_size):
+            bid = self._blocks.get(h)
+            if bid is None:
+                break
+            ids.append(bid)
+        return ids
+
+    def insert(self, tokens, table) -> int:
+        """Map the leading `len(table)` full chunks of `tokens` to the
+        given block ids (first-writer-wins). Returns how many NEW
+        entries landed."""
+        new = 0
+        for k, h in enumerate(chunk_hashes(tokens, self.block_size)):
+            if k >= len(table):
+                break
+            bid = int(table[k])
+            if h in self._blocks or bid in self._hash_of:
+                continue
+            self._blocks[h] = bid
+            self._hash_of[bid] = h
+            new += 1
+        return new
+
+    def drop_block(self, bid: int) -> None:
+        h = self._hash_of.pop(bid, None)
+        if h is not None:
+            self._blocks.pop(h, None)
 
 
 def gather_table(pool_blk, bt):

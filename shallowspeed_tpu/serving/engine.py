@@ -107,8 +107,9 @@ from shallowspeed_tpu.telemetry.tracing import new_span_id, new_trace_id
 from shallowspeed_tpu.models import transformer as T
 from shallowspeed_tpu.models.kv_cache import masked_attention
 from shallowspeed_tpu.serving.cache import (SCRATCH_BLOCK, BlockAllocator,
-                                            OutOfBlocks, blocks_for,
-                                            gather_table, init_block_pool,
+                                            OutOfBlocks, PrefixIndex,
+                                            blocks_for, gather_table,
+                                            init_block_pool,
                                             paged_read_bytes_per_tick,
                                             param_read_bytes, write_rows)
 
@@ -250,8 +251,8 @@ def _decode_tick(params, pools, tok, pos, bt, temp, seeds, idx, *,
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def _prefill_chunk(params, pools, tokens, pos0, n_tok, bt, *,
-                   cfg: T.TransformerConfig):
+def _prefill_chunk(params, pools, tokens, pos0, n_tok, bt, cow_src,
+                   cow_dst, *, cfg: T.TransformerConfig):
     """One chunk of a request's prefill: tokens (1, C) — C is the
     fixed chunk length, `n_tok` the traced true count (the tail is
     padding, steered to the scratch block exactly like `generate`'s
@@ -259,12 +260,29 @@ def _prefill_chunk(params, pools, tokens, pos0, n_tok, bt, *,
     K/V through the block table and attends causally over the table
     (earlier chunks included). Returns (f32 logits at the chunk's last
     true position — consumed only on the final chunk — and the
-    updated, donated pools)."""
+    updated, donated pools).
+
+    PREFIX-CACHE ALIGNMENT CONTRACT: cache hits are granular to WHOLE
+    blocks — `pos0` on a hit is the matched aligned token count, so the
+    partial tail (and on a fully-aligned match, the final token of the
+    copied tail block) always re-prefills through here; the engine
+    never trusts a partially-filled shared block. `cow_src`/`cow_dst`
+    are the copy-on-write pair: before any write, every pool leaf
+    copies block `cow_src` into block `cow_dst` (one block per layer),
+    so a request that diverges inside an otherwise-shared tail block
+    writes its OWN copy and the shared block stays bit-unchanged.
+    Cache-off and already-diverged calls pass scratch for both — a
+    scratch->scratch self-copy that is a no-op by construction (nothing
+    reads scratch). Riding the copy inside this one jitted program (as
+    data, every call) keeps `executable_counts()` flat: cache hits
+    change block-table *data*, never the compiled-program set."""
     params = T.cast_params(params, cfg.compute_dtype)
     c = tokens.shape[1]
     bs = pools[0]["k"].shape[2]
     w = bt.shape[1]
     quant = "k_s" in pools[0]
+    pools = [{name: leaf.at[cow_dst].set(leaf[cow_src])
+              for name, leaf in pool.items()} for pool in pools]
     pos = pos0 + jnp.arange(c)
     x = G._embed(params, tokens, pos0, cfg)                  # (1, C, d)
     j = jnp.arange(c)
@@ -305,7 +323,8 @@ class _Req:
                  "queued_at", "wait_s", "first_tok_t", "last_tok",
                  "timeline", "track", "trace_t0", "n_drafted",
                  "n_accepted", "ctx_ids", "spec_idx",
-                 "trace", "span", "parent", "attempt")
+                 "trace", "span", "parent", "attempt",
+                 "hit_blocks", "skipped_tok", "cow")
 
     def __init__(self, rid, prompt, max_new, temp, seed, arrival):
         self.rid = rid
@@ -347,6 +366,14 @@ class _Req:
         self.span = None
         self.parent = None
         self.attempt = 0
+        # prefix caching (schema v14): blocks mapped from the shared
+        # index across every admission stint, prefill tokens those
+        # mappings skipped, and the pending (src, dst) copy-on-write
+        # pair the first prefill chunk after a fully-aligned hit
+        # resolves (None otherwise)
+        self.hit_blocks = 0
+        self.skipped_tok = 0
+        self.cow = None
 
 
 class ServingEngine:
@@ -364,7 +391,8 @@ class ServingEngine:
                  spec_k: int = 0, spec_ngram: int = 3,
                  top_k: int = 0, top_p: float = 0.0, metrics=None,
                  log_every: int = 0, clock=time.time,
-                 lifecycle: bool = True, chaos_plan=None):
+                 lifecycle: bool = True, chaos_plan=None,
+                 prefix_cache: bool = False):
         if attn_impl not in ("gather", "flash"):
             raise ValueError(
                 f"unsupported attn_impl={attn_impl!r}; expected "
@@ -402,7 +430,17 @@ class ServingEngine:
         # tests pass an explicit plan to fault ONE of N engines.
         self.chaos_plan = chaos_plan
         self.pools = init_block_pool(cfg, n_blocks, block_size, kv_quant)
-        self.alloc = BlockAllocator(n_blocks)
+        # prefix caching (round 19): a content-addressed index over
+        # block-aligned prompt chunks. `_admit` probes it, finished
+        # requests donate their sealed prefix blocks (refcount-zero
+        # indexed blocks park on the allocator's cold LRU list instead
+        # of freeing), and the divergence/tail block copies-on-write
+        # inside `_prefill_chunk`. Off by default: the strict
+        # n_free == n_usable drain invariant holds exactly as before;
+        # on, the extended invariant is n_free + n_cold == n_usable at
+        # drain (cold = donated, still-matchable cache).
+        self.prefix = PrefixIndex(block_size) if prefix_cache else None
+        self.alloc = BlockAllocator(n_blocks, index=self.prefix)
         # constant param term at the STORAGE dtypes actually served
         # (int8/fp8 values + f32 scales when weight_quant is on)
         self._p_bytes = param_read_bytes(self.params, cfg)
@@ -417,7 +455,8 @@ class ServingEngine:
         self.counters = {"submitted": 0, "finished": 0, "preempted": 0,
                          "ticks": 0, "prefill_chunks": 0,
                          "shed_toggles": 0, "spec_drafted": 0,
-                         "spec_accepted": 0}
+                         "spec_accepted": 0, "prefix_lookups": 0,
+                         "prefix_hits": 0, "prefix_skipped_tokens": 0}
         # SLO load shedding (round 12, telemetry/monitor): while
         # `admission_paused`, `_admit` leaves the queue alone — running
         # requests keep every slot/block they hold and drain the
@@ -441,6 +480,8 @@ class ServingEngine:
         self._last_touched = 0
         self._win_drafted = 0           # spec-decode window tallies
         self._win_accepted = 0
+        self._win_prefix_lookups = 0    # prefix-cache window tallies
+        self._win_prefix_hits = 0
         # decode-tick width buckets already executed (and so already
         # compiled): the FIRST tick at a new width re-traces — stamped
         # as a `table_rebucket` ledger event so attribution can book
@@ -696,15 +737,46 @@ class ServingEngine:
         while self.queue and None in self.slots:
             req = self.queue[0]
             need = blocks_for(len(req.ctx), self.block_size)
+            # prefix-cache probe: map the longest indexed aligned
+            # prefix straight into the block table and start chunked
+            # prefill at the divergence point. A FULLY-aligned match
+            # (every block of ctx indexed) still re-prefills its last
+            # token: the tail block copies-on-write into a fresh block
+            # so decode can append without mutating the shared one,
+            # and the final-position logits come from a real chunk.
+            matched: list[int] = []
+            if self.prefix is not None:
+                matched = self.prefix.match(req.ctx)
+                self.counters["prefix_lookups"] += 1
+                self._win_prefix_lookups += 1
+            m = len(matched)
+            full = m > 0 and m * self.block_size == len(req.ctx)
             try:
-                table = self.alloc.alloc(need)
+                if matched:
+                    self.alloc.acquire(matched)
+                try:
+                    fresh = self.alloc.alloc(need - m + (1 if full else 0))
+                except OutOfBlocks:
+                    if matched:          # all-or-nothing admission
+                        self.alloc.release(matched)
+                    raise
             except OutOfBlocks:
                 break                # wait for blocks to free
             self.queue.popleft()
             slot = self.slots.index(None)
             req.slot = slot
-            req.table = table
-            req.written = 0
+            if full:
+                # hold the matched tail block (the CoW source) by the
+                # acquire above until the copy lands in the first
+                # prefill chunk; the table gets the fresh copy instead
+                req.cow = (matched[-1], fresh[0])
+                req.table = matched[:-1] + fresh
+                req.written = len(req.ctx) - 1
+            else:
+                req.cow = None
+                req.table = matched + fresh
+                req.written = m * self.block_size
+            skipped = req.written
             req.phase = "prefill"
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
@@ -714,6 +786,14 @@ class ServingEngine:
             req.wait_s += req.admit_t - req.queued_at
             self.slots[slot] = req
             self._lifecycle(req, "admitted", slot=slot)
+            if m > 0:
+                req.hit_blocks += m
+                req.skipped_tok += skipped
+                self.counters["prefix_hits"] += 1
+                self.counters["prefix_skipped_tokens"] += skipped
+                self._win_prefix_hits += 1
+                self._lifecycle(req, "prefill_cached", blocks=m,
+                                tokens=int(skipped))
             did = True
         return did
 
@@ -732,9 +812,19 @@ class ServingEngine:
         w = table_width(len(req.table), self.table_bucket)
         bt = np.full((1, w), SCRATCH_BLOCK, np.int32)
         bt[0, :len(req.table)] = req.table
+        # copy-on-write rides the chunk as DATA on every call (scratch
+        # self-copy when there is nothing to copy) — zero executables
+        cow = req.cow if req.cow is not None \
+            else (SCRATCH_BLOCK, SCRATCH_BLOCK)
         logits, self.pools = _prefill_chunk(
             self.params, self.pools, tokens, np.int32(req.written),
-            np.int32(n_tok), bt, cfg=self.cfg)
+            np.int32(n_tok), bt, np.int32(cow[0]), np.int32(cow[1]),
+            cfg=self.cfg)
+        if req.cow is not None:
+            # the copy landed: drop the reference that kept the shared
+            # source block alive for it
+            self.alloc.release([req.cow[0]])
+            req.cow = None
         req.written += n_tok
         self.counters["prefill_chunks"] += 1
         if req.written == len(req.ctx):
@@ -963,11 +1053,17 @@ class ServingEngine:
         return True
 
     def _evict(self, req) -> None:
-        """Preempt: free the blocks NOW, re-queue at the front. The
-        request keeps its generated tokens and sampling indices — on
-        re-admission it re-prefills prompt + generated and continues
-        its stream exactly where it stopped."""
-        self.alloc.free(req.table)
+        """Preempt: release the block references NOW, re-queue at the
+        front. The request keeps its generated tokens and sampling
+        indices — on re-admission it re-prefills prompt + generated
+        (re-probing the prefix index, so a still-cached prefix skips
+        again) and continues its stream exactly where it stopped.
+        Shared blocks other requests still reference stay live; only
+        this request's references drop."""
+        if req.cow is not None:          # pending CoW source reference
+            self.alloc.release([req.cow[0]])
+            req.cow = None
+        self.alloc.release(req.table)
         req.table = []
         req.written = 0
         req.ctx = np.concatenate(
@@ -996,7 +1092,21 @@ class ServingEngine:
             self._finish(req)
 
     def _finish(self, req) -> None:
-        self.alloc.free(req.table)
+        # donate the sealed aligned prefix to the cache BEFORE the
+        # release: indexed blocks whose refcount hits zero park on the
+        # cold LRU list (still matchable, reclaimed under pressure)
+        # instead of returning to the free list. Only blocks fully
+        # covered by PREFILL-written context are sealed — decode-
+        # written positions live past len(ctx) and never land in a
+        # donated block.
+        if self.prefix is not None and req.table:
+            sealed = min(req.written, len(req.ctx)) // self.block_size
+            if sealed > 0:
+                self.prefix.insert(req.ctx, req.table[:sealed])
+        if req.cow is not None:
+            self.alloc.release([req.cow[0]])
+            req.cow = None
+        self.alloc.release(req.table)
         req.table = []
         self._lifecycle(req, "finished", tokens=len(req.generated))
         if self.lifecycle:
@@ -1032,6 +1142,9 @@ class ServingEngine:
         if self.spec_k > 0:  # schema v9: per-request speculation record
             rec["spec_drafted"] = req.n_drafted
             rec["spec_accepted"] = req.n_accepted
+        if self.prefix is not None:  # schema v14: prefix-cache record
+            rec["prefix_hit_blocks"] = req.hit_blocks
+            rec["prefill_skipped_tokens"] = req.skipped_tok
         self.request_records.append(rec)
         if self.metrics is not None:
             self.metrics.log(event="request", **rec)
@@ -1053,6 +1166,13 @@ class ServingEngine:
                      "spec_accept_rate": round(
                          self._win_accepted / self._win_drafted, 4)
                      if self._win_drafted else 0.0}
+        if self.prefix is not None:  # schema v14: prefix-cache gauges
+            extra.update(
+                prefix_hit_rate=round(
+                    self._win_prefix_hits / self._win_prefix_lookups, 4)
+                if self._win_prefix_lookups else 0.0,
+                cold_blocks=self.alloc.n_cold,
+                prefix_blocks=len(self.prefix))
         self.metrics.log(
             event="generate",
             tokens_per_sec=round(self._win_tokens / dt, 2),
@@ -1066,4 +1186,6 @@ class ServingEngine:
         self._win_tokens = 0
         self._win_drafted = 0
         self._win_accepted = 0
+        self._win_prefix_lookups = 0
+        self._win_prefix_hits = 0
         self._win_t = now

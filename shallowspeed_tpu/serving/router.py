@@ -91,6 +91,7 @@ import numpy as np
 from shallowspeed_tpu.elastic import (RestartPolicy, classify_exit,
                                       read_heartbeat_status,
                                       write_heartbeat)
+from shallowspeed_tpu.serving.cache import chunk_hashes
 from shallowspeed_tpu.telemetry.monitor import parse_slos
 from shallowspeed_tpu.telemetry.tracing import new_span_id, new_trace_id
 
@@ -683,7 +684,7 @@ class _RouterReq:
                  "submit_t", "deadline", "tokens", "replica",
                  "dispatch_t", "last_progress_t", "first_tok_t",
                  "failovers", "failover_from", "failover_reason",
-                 "exclude", "trace", "span", "attempt")
+                 "exclude", "trace", "span", "attempt", "fp")
 
     def __init__(self, rid, prompt, max_new, temp, seed, now,
                  deadline):
@@ -710,6 +711,11 @@ class _RouterReq:
         self.trace = new_trace_id()
         self.span = new_span_id()
         self.attempt = -1                 # first dispatch -> 0
+        # sticky routing: chained hashes of the prompt's leading
+        # aligned chunks (the same chunk identity the engines' prefix
+        # index keys on) — empty when sticky is off or the prompt is
+        # shorter than one chunk
+        self.fp: tuple = ()
 
 
 class Router:
@@ -731,7 +737,10 @@ class Router:
                  autoscale: bool = False, min_replicas: int = 1,
                  max_replicas: int = 4, scale_hold_s: float = 5.0,
                  idle_drain_s: float = 30.0,
-                 scale_cooldown_s: float = 10.0):
+                 scale_cooldown_s: float = 10.0,
+                 sticky: bool = True, sticky_block: int = 16,
+                 sticky_bonus: float = 0.5, sticky_cap: float = 1.5,
+                 sticky_history: int = 2048):
         self.spawn = spawn
         self.collector = collector
         self.metrics = metrics
@@ -748,6 +757,23 @@ class Router:
         self.scale_hold_s = float(scale_hold_s)
         self.idle_drain_s = float(idle_drain_s)
         self.scale_cooldown_s = float(scale_cooldown_s)
+        # sticky prefix-affinity routing (round 19): the router
+        # fingerprints each prompt's leading aligned chunks
+        # (`cache.chunk_hashes`, the SAME chunk identity the replicas'
+        # prefix index keys on) and remembers, per replica, which
+        # chunks its own dispatch history sent where. At rank time a
+        # replica earns a bonus of `sticky_bonus` per matched leading
+        # chunk, CAPPED at `sticky_cap` — one queued request outscores
+        # the cap, so load/burn signals always override locality and a
+        # popular prefix cannot create a hotspot. Pure dispatch-side
+        # state: failover re-dispatch (`generated=`) stays correct
+        # because the fallback replica simply misses its cache.
+        self.sticky = bool(sticky)
+        self.sticky_block = int(sticky_block)
+        self.sticky_bonus = float(sticky_bonus)
+        self.sticky_cap = float(sticky_cap)
+        self.sticky_history = int(sticky_history)
+        self._affinity: dict[str, dict[bytes, None]] = {}
         self._rng = random.Random(seed)
         # fleet-edge SLO rules: ttft fed from the router's own
         # submit→first-token observations, availability from replica
@@ -842,6 +868,8 @@ class Router:
             else self.default_deadline_s
         req = _RouterReq(rid, prompt, max_new, temperature, seed, now,
                          now + dl if dl is not None else None)
+        if self.sticky:
+            req.fp = tuple(chunk_hashes(req.prompt, self.sticky_block))
         self.pending.append(req)
         self.counters["submitted"] += 1
         return rid
@@ -966,6 +994,9 @@ class Router:
         entry["down_since"] = now
         entry["fail_class"] = fail_class
         self._breakers[name].force_open(now)
+        # a dead replica's prefix cache died with it — its affinity
+        # history must not attract the respawned (cold) successor
+        self._affinity.pop(name, None)
         # in-flight work fails over: back to the FRONT of the queue,
         # carrying every token already received — the re-dispatch
         # re-prefills prompt + prefix on another replica and the
@@ -1133,6 +1164,36 @@ class Router:
             s += min(float(ttft) / 1e3, 10.0)    # seconds of p50 ttft
         return s
 
+    def _affinity_bonus(self, name: str, req) -> float:
+        """Sticky prefix-affinity bonus: `sticky_bonus` per LEADING
+        fingerprint chunk this replica has already served (contiguous
+        from the front — a mid-prompt match is useless to the prefix
+        cache), capped at `sticky_cap` so one unit of queue pressure
+        always outranks locality."""
+        if not req.fp:
+            return 0.0
+        seen = self._affinity.get(name)
+        if not seen:
+            return 0.0
+        n = 0
+        for h in req.fp:
+            if h not in seen:
+                break
+            n += 1
+        return min(self.sticky_cap, self.sticky_bonus * n)
+
+    def _note_affinity(self, name: str, req) -> None:
+        """Record the dispatched prompt's chunks in `name`'s affinity
+        history (LRU, bounded at sticky_history)."""
+        if not req.fp:
+            return
+        seen = self._affinity.setdefault(name, {})
+        for h in req.fp:
+            seen.pop(h, None)          # re-insert at the MRU end
+            seen[h] = None
+        while len(seen) > self.sticky_history:
+            seen.pop(next(iter(seen)))
+
     def _dispatch(self, now: float) -> bool:
         if not self.pending:
             return False        # nothing to place — don't pay the
@@ -1150,8 +1211,13 @@ class Router:
                   and self._breakers[n].state == "closed"}
         while self.pending:
             req = self.pending[0]
+            # sticky: fold the bounded prefix-affinity bonus into the
+            # per-request ranking (scores themselves stay load-only —
+            # the +1.0 landing bump below keeps overriding locality)
             ranked = sorted((n for n in scores if n != req.exclude),
-                            key=lambda n: (scores[n], n))
+                            key=lambda n: (scores[n]
+                                           - self._affinity_bonus(n, req),
+                                           n))
             if not ranked and req.exclude is not None:
                 # nowhere else to go. If this is a TIMEOUT failover
                 # and its old replica is still up, the work is still
@@ -1222,6 +1288,11 @@ class Router:
                 req.attempt = attempt_next
                 self.inflight[req.rid] = req
                 scores[name] = scores.get(name, 0.0) + 1.0
+                # snapshot the bonus that influenced THIS ranking
+                # before the landing itself is recorded into history
+                aff = self._affinity_bonus(name, req)
+                if self.sticky:
+                    self._note_affinity(name, req)
                 if req.failover_from is not None:
                     req.failovers += 1
                     self.counters["failovers"] += 1
@@ -1238,9 +1309,13 @@ class Router:
                     req.failover_reason = None
                 else:
                     self.counters["routes"] += 1
+                    extra_route = {}
+                    if self.sticky:
+                        extra_route["affinity"] = round(aff, 3)
                     self._emit("route", id=req.rid, replica=name,
                                queue_depth=len(self.pending),
                                score=round(scores[name] - 1.0, 3),
+                               **extra_route,
                                trace=req.trace, span=span_k,
                                parent=req.span,
                                dispatch_wall=round(pre_wall, 6),

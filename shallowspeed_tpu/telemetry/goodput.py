@@ -352,7 +352,49 @@ def run_goodput(path, extra_paths=()) -> dict:
         # floor, shadow-parity extremes, verdicts fired, and whether
         # the run ended on the bf16 fallback
         "numerics": _numerics_block(recs),
+        # None without schema-v14 prefix-cache fields — the prefix
+        # caching story: hit rate across requests, prefill tokens the
+        # shared-block mappings skipped, and the last tick's cold-list
+        # / index gauges
+        "prefix": _prefix_block(recs, request_recs),
     }
+
+
+def _prefix_block(recs, request_recs) -> dict | None:
+    """Reduce schema-v14 prefix-cache fields: per-request
+    `prefix_hit_blocks`/`prefill_skipped_tokens` tallies plus the last
+    "generate" tick's `prefix_hit_rate`/`cold_blocks`/`prefix_blocks`
+    gauges. None when the run never served with the prefix cache on."""
+    reqs = [r for r in request_recs
+            if isinstance(r.get("prefix_hit_blocks"), int)
+            and not isinstance(r.get("prefix_hit_blocks"), bool)]
+    gens = [r for r in recs if r.get("event") == "generate"
+            and isinstance(r.get("prefix_hit_rate"), (int, float))]
+    if not reqs and not gens:
+        return None
+    hits = sum(1 for r in reqs if r["prefix_hit_blocks"] > 0)
+    skipped = sum(int(r.get("prefill_skipped_tokens") or 0)
+                  for r in reqs)
+    prefilled = sum(int(r.get("tokens_in") or 0) for r in reqs)
+    out = {
+        "requests_observed": len(reqs),
+        "requests_hit": hits,
+        "hit_rate": round(hits / len(reqs), 4) if reqs else None,
+        "hit_blocks": sum(r["prefix_hit_blocks"] for r in reqs),
+        "prefill_skipped_tokens": skipped,
+        # what fraction of submitted prompt tokens never re-prefilled
+        "skipped_frac": (round(skipped / prefilled, 4)
+                         if prefilled > 0 else None),
+    }
+    if gens:
+        last = gens[-1]
+        out["cold_blocks"] = (int(last["cold_blocks"])
+                              if isinstance(last.get("cold_blocks"), int)
+                              else None)
+        out["prefix_blocks"] = (int(last["prefix_blocks"])
+                                if isinstance(last.get("prefix_blocks"),
+                                              int) else None)
+    return out
 
 
 def _numerics_block(recs) -> dict | None:
@@ -642,6 +684,19 @@ def format_report(rep: dict) -> str:
             f"{ms(req['tpot_ms_p95'])} ms  "
             f"tokens {req['tokens_in']}->{req['tokens_out']}  "
             f"preempted {req['preempted']}")
+    pfx = rep.get("prefix")
+    if pfx:
+        hr = pfx.get("hit_rate")
+        sf = pfx.get("skipped_frac")
+        lines.append(
+            f"prefix cache: {pfx['requests_hit']}/"
+            f"{pfx['requests_observed']} request(s) hit"
+            + (f" ({hr:.0%})" if hr is not None else "")
+            + f", {pfx['prefill_skipped_tokens']} prefill "
+            f"token(s) skipped"
+            + (f" ({sf:.0%} of prompt tokens)" if sf is not None else "")
+            + (f", {pfx['cold_blocks']} cold block(s)"
+               if pfx.get("cold_blocks") is not None else ""))
     lc = rep.get("lifecycle")
     if lc:
         top = sorted(lc["by_phase_ms"].items(),

@@ -508,7 +508,12 @@ class Monitor:
                       # — acceptance rate rides /status.json so a
                       # fleet view sees whether speculation is paying
                       "spec_drafted", "spec_accepted",
-                      "spec_accept_rate"):
+                      "spec_accept_rate",
+                      # schema v14: prefix-cache gauges — hit rate +
+                      # cold-list/index size ride /status.json so the
+                      # fleet view sees whether caching is paying
+                      "prefix_hit_rate", "cold_blocks",
+                      "prefix_blocks"):
             if field in rec:
                 self.serving[field] = rec[field]
         for rule in self.rules:
@@ -847,7 +852,8 @@ class Monitor:
                     lines.append(f"# TYPE {P}{name} gauge")
                     lines.append(f"{P}{name} {v:.6g}")
             for field in ("queue_depth", "active_slots", "free_blocks",
-                          "spec_accept_rate"):
+                          "spec_accept_rate", "prefix_hit_rate",
+                          "cold_blocks", "prefix_blocks"):
                 v = self.serving.get(field)
                 if isinstance(v, (int, float)):
                     lines.append(f"# TYPE {P}{field} gauge")

@@ -60,6 +60,13 @@ METRICS = {
     # moves it a little per host), so a tight floor; rounds before
     # r18 lack the metric and pass vacuously
     "fp8_mxu_shrink": (0.10, None),
+    # prefix-caching fleet headline (round 19, bench.py bench_prefix:
+    # the 2-replica sticky-routing shared-prompt sweep, prefix cache
+    # on): the same dispatch noise as fleet_tok_per_sec plus the
+    # cache-hit admission path — a drop here with fleet_tok_per_sec
+    # flat means prefix caching or sticky routing stopped paying;
+    # rounds before r19 lack the metric and pass vacuously
+    "prefix_tok_per_sec": (0.35, None),
 }
 
 

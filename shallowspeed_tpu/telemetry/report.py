@@ -158,7 +158,7 @@ def request_timeline(source, rid: str | None = None) -> dict:
                 entry = {k: cur[k] for k in
                          ("phase", "wall", "ms_in_prev", "prev", "slot",
                           "tick", "chunk", "tokens", "attempt",
-                          "resumed", "trace") if k in cur}
+                          "resumed", "trace", "blocks") if k in cur}
                 phases.append(entry)
                 if prev is None:
                     continue
@@ -181,11 +181,18 @@ def request_timeline(source, rid: str | None = None) -> dict:
             # is the stitcher's (router-clock) number, not a raw delta
             e2e = round((phases[-1]["wall"] - phases[0]["wall"]) * 1e3,
                         3)
+        # v14: prefill the prefix cache skipped, booked EXPLICITLY (a
+        # cache-hit request's rq_prefill is honestly fast — the
+        # prefill_cached stamps say how many tokens never ran)
+        skipped = sum(p.get("tokens", 0) for p in phases
+                      if p.get("phase") == "prefill_cached"
+                      and isinstance(p.get("tokens"), int))
         out[r] = {"phases": phases,
                   "by_phase_ms": {k: round(v, 3)
                                   for k, v in sorted(by_phase.items())},
                   "complete": complete,
                   "attempts": len(ordered),
+                  "skipped_tokens": skipped,
                   "e2e_ms": e2e}
     return out
 

@@ -124,12 +124,22 @@ from pathlib import Path
 # num_verdicts (the drained scale_collapse / parity_drift window,
 # mirroring health_verdicts); "ledger" lines allow the
 # `shadow_parity` kind's seconds (goodput-excluded oracle steps).
+# 14 = v13 plus the prefix-caching extension (round 19,
+# `serving/cache.PrefixIndex` + sticky routing): "request" lines grow
+# `prefix_hit_blocks` (shared blocks mapped from the index across the
+# request's admission stints) and `prefill_skipped_tokens` (prefill
+# work those mappings avoided); "lifecycle" lines allow the
+# `prefill_cached` phase (with `blocks` = matched block count) so
+# `report.request_timeline` books the skipped prefill explicitly;
+# "generate" tick lines grow the `prefix_hit_rate` / `cold_blocks` /
+# `prefix_blocks` gauges /status.json + /metrics + the fleet view
+# surface; "route" lines may carry the sticky `affinity` bonus.
 # The validator accepts ALL dialects — every versioned field is
-# optional, so committed v1-v12 artifacts (no version stamp / no
+# optional, so committed v1-v13 artifacts (no version stamp / no
 # health / overlap / attrib / wall / fault / request / monitor /
 # straggler / lifecycle / speculation / routing / tracing / profile /
-# numerics fields) keep validating unchanged.
-SCHEMA_VERSION = 13
+# numerics / prefix fields) keep validating unchanged.
+SCHEMA_VERSION = 14
 
 _NUM = (int, float)
 
@@ -214,7 +224,10 @@ _REQUEST_OPTIONAL = {"tpot_ms": _NUM, "e2e_ms": _NUM, "wait_ms": _NUM,
                      # v10: the router's fleet-edge request records
                      "replica": str, "failovers": int,
                      # v11: trace context (telemetry/tracing.py)
-                     "trace": str, "span": str, "attempt": int}
+                     "trace": str, "span": str, "attempt": int,
+                     # v14: prefix-cache record (serving/cache)
+                     "prefix_hit_blocks": int,
+                     "prefill_skipped_tokens": int}
 
 # optional typed fields on a "generate" line (schema v9: the serving
 # tick fields written since v6 become typed, plus the speculation
@@ -223,7 +236,10 @@ _GENERATE_OPTIONAL = {"queue_depth": int, "active_slots": int,
                       "free_blocks": int, "blocks_touched": int,
                       "bytes_per_tick": int, "hbm_gbps": _NUM,
                       "spec_drafted": int, "spec_accepted": int,
-                      "spec_accept_rate": _NUM}
+                      "spec_accept_rate": _NUM,
+                      # v14: prefix-cache window gauges
+                      "prefix_hit_rate": _NUM, "cold_blocks": int,
+                      "prefix_blocks": int}
 
 # optional typed fields on the schema-v7 events
 _MONITOR_OPTIONAL = {"counters": dict, "rel_err": _NUM}
@@ -242,7 +258,10 @@ _LIFECYCLE_OPTIONAL = {"seq": int, "slot": int, "tick": int,
                        # = the router's dispatch span, attempt = the
                        # 0-based cross-engine dispatch counter
                        "trace": str, "span": str, "parent": str,
-                       "attempt": int}
+                       "attempt": int,
+                       # v14: `prefill_cached` phase payload — shared
+                       # blocks mapped from the prefix index at admit
+                       "blocks": int}
 
 # optional typed fields on the schema-v10 routing events (trace/span/
 # parent + route wait_ms are the v11 tracing extension;
@@ -252,7 +271,10 @@ _LIFECYCLE_OPTIONAL = {"seq": int, "slot": int, "tick": int,
 _ROUTE_OPTIONAL = {"queue_depth": int, "score": _NUM,
                    "trace": str, "span": str, "parent": str,
                    "wait_ms": _NUM,
-                   "dispatch_wall": _NUM, "dispatch_mono": _NUM}
+                   "dispatch_wall": _NUM, "dispatch_mono": _NUM,
+                   # v14: the sticky prefix-affinity bonus folded into
+                   # this dispatch's ranking (0.0 = no locality)
+                   "affinity": _NUM}
 _FAILOVER_OPTIONAL = {"from": str, "tokens_done": int, "attempt": int,
                       "trace": str, "span": str, "parent": str,
                       "dispatch_wall": _NUM, "dispatch_mono": _NUM}

@@ -65,6 +65,7 @@ PHASE_COMPONENT = {
     "requeued": "rq_queue",
     "preempted": "rq_queue",
     "admitted": "rq_prefill",
+    "prefill_cached": "rq_prefill",   # v14: prefix-cache hit at admit
     "prefill": "rq_prefill",
     "decoding": "rq_decode",
     "finished": "rq_dispatch",   # finished -> router finalize = poll

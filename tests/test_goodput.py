@@ -221,12 +221,17 @@ def test_regress_gate_passes_on_committed_trajectory(capsys):
 def test_regress_gate_fails_on_synthetic_regression(tmp_path, capsys):
     from shallowspeed_tpu.telemetry.regress import main as rmain
 
+    rounds = []
     for f in sorted(ROOT.glob("BENCH_r*.json")):
         shutil.copy(f, tmp_path / f.name)
+        rounds.append(int(json.loads(f.read_text()).get("n", 0)))
     bad = json.loads((ROOT / "BENCH_r05.json").read_text())
-    bad["n"] = 6
+    # the synthetic regression must be the NEWEST round — the gate
+    # only judges the last entry, so pin past the committed trajectory
+    bad["n"] = max(rounds) + 1
     bad["parsed"]["transformer_mfu"] = 0.40   # ~29% below the median
-    (tmp_path / "BENCH_r06.json").write_text(json.dumps(bad))
+    (tmp_path / f"BENCH_r{bad['n']:02d}.json").write_text(
+        json.dumps(bad))
     assert rmain([str(tmp_path)]) == 1
     out = capsys.readouterr().out
     assert "REGRESSION" in out and "transformer_mfu" in out
@@ -320,3 +325,48 @@ def test_driver_goodput_profile_and_decode_lines(tmp_path, driver):
     # telemetry.json carries the in-process ledger totals
     summary = json.loads((trace / "telemetry.json").read_text())
     assert summary["goodput_ledger"]["seconds"].get("val", 0) > 0
+
+
+def test_goodput_prefix_cache_block(tmp_path):
+    """Schema-v14 prefix reduction: request lines' hit-blocks /
+    skipped-tokens tallies plus the last generate tick's gauges land
+    in rep["prefix"], the formatted report prints the hit-rate line,
+    and a run without the fields reports prefix=None (the cache-off
+    shape is unchanged)."""
+    from shallowspeed_tpu.telemetry.goodput import (format_report,
+                                                    run_goodput)
+
+    log = tmp_path / "serve.jsonl"
+    base = {"event": "request", "ttft_ms": 5.0, "tpot_ms": 1.0,
+            "tokens_out": 8}
+    _write_jsonl(log, [
+        {"event": "run_start", "start_step": 0, "wall": 1000.0},
+        dict(base, id="cold", tokens_in=32, prefix_hit_blocks=0,
+             prefill_skipped_tokens=0, wall=1000.1),
+        dict(base, id="hit", tokens_in=32, prefix_hit_blocks=4,
+             prefill_skipped_tokens=31, wall=1000.2),
+        dict(base, id="part", tokens_in=48, prefix_hit_blocks=2,
+             prefill_skipped_tokens=16, wall=1000.3),
+        {"event": "generate", "tokens_per_sec": 100.0,
+         "prefix_hit_rate": 0.5, "cold_blocks": 6, "prefix_blocks": 6,
+         "wall": 1000.4},
+    ])
+    pfx = run_goodput(log)["prefix"]
+    assert pfx["requests_observed"] == 3
+    assert pfx["requests_hit"] == 2
+    assert pfx["hit_rate"] == pytest.approx(2 / 3, abs=1e-3)
+    assert pfx["hit_blocks"] == 6
+    assert pfx["prefill_skipped_tokens"] == 47
+    assert pfx["skipped_frac"] == pytest.approx(47 / 112, abs=1e-3)
+    assert pfx["cold_blocks"] == 6 and pfx["prefix_blocks"] == 6
+    assert "prefix cache: 2/3 request(s) hit" in \
+        format_report(run_goodput(log))
+    # cache-off runs keep the old shape: no prefix block at all
+    off = tmp_path / "off.jsonl"
+    _write_jsonl(off, [
+        {"event": "run_start", "start_step": 0, "wall": 1000.0},
+        dict(base, id="a", tokens_in=16, wall=1000.1),
+    ])
+    rep = run_goodput(off)
+    assert rep["prefix"] is None
+    assert "prefix cache" not in format_report(rep)

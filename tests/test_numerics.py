@@ -270,7 +270,7 @@ def test_chaos_scale_poison_typed_error_without_history():
 
 
 def test_schema_v13_step_lines():
-    assert SCHEMA_VERSION == 13
+    assert SCHEMA_VERSION >= 13
     base = {"event": "step", "step": 4, "loss": 0.5,
             "tokens_per_sec": 100.0, "t": 1.0, "wall": 1.0}
     good = dict(base, num_overflow_max=0.5, num_underflow_max=0.0,

@@ -684,3 +684,77 @@ def test_fleet_chaos_drill_cross_process(tmp_path):
         assert wf is not None
         assert abs(wf["rq_unexplained_frac"]) <= 0.05, (jn["rid"], wf)
         assert wf["rq_failover_gap_ms"] > 0.0
+
+
+# --------------------------- sticky prefix affinity (round 19)
+
+
+def test_router_sticky_prefix_affinity(serving_fixture):
+    """Sticky routing homes a shared-prefix family on the replica
+    that already served it (decisively — the 1.5-capped bonus beats
+    one unit of queue pressure plus telemetry noise), load still
+    overrides locality once the home's backlog exceeds the cap, the
+    route events carry the schema-v14 affinity field, and every
+    stream stays token-identical to its solo oracle."""
+    params, cfg = serving_fixture
+    router = Router(make_spawn(params, cfg, prefix_cache=True,
+                               prefill_chunk=8),
+                    n_replicas=2, request_timeout=None,
+                    sticky=True, sticky_block=8)
+    fam = toks(50, t=32)                 # 4 fingerprint chunks of 8
+    oracle = solo(params, cfg, fam, 4, temperature=0.0)
+    router.submit(fam, 4, rid="cold")
+    router.run(max_wall=120)
+    routes = {e["id"]: e for e in router.events
+              if e["event"] == "route"}
+    home = routes["cold"]["replica"]
+    assert routes["cold"]["affinity"] == 0.0     # nothing seen yet
+    # a decoy occupies whichever replica the load tie-break prefers;
+    # the family's sharer must still go HOME (bonus 1.5 > load 1)
+    router.submit(toks(51, t=32), 4, rid="decoy")
+    router.submit(fam, 4, rid="warm", temperature=0.8, seed=7)
+    router.run(max_wall=120)
+    routes = {e["id"]: e for e in router.events
+              if e["event"] == "route"}
+    assert routes["warm"]["replica"] == home
+    assert routes["warm"]["affinity"] >= 1.0
+    np.testing.assert_array_equal(
+        router.results["warm"],
+        solo(params, cfg, fam, 4, temperature=0.8, seed=7))
+    np.testing.assert_array_equal(router.results["cold"], oracle)
+    # bounded: a burst of sharers overflows once home's backlog
+    # exceeds the cap — the 3rd concurrent family request spills to
+    # the other replica instead of queueing behind locality
+    for i in range(3):
+        router.submit(fam, 4, rid=f"burst{i}", temperature=0.0)
+    router.step()
+    placed = {r.rid: r.replica for r in router.inflight.values()}
+    assert placed["burst0"] == home and placed["burst1"] == home
+    assert placed["burst2"] != home, (
+        "sticky bonus outranked load — the cap is not bounding")
+    router.run(max_wall=120)
+    for i in range(3):
+        np.testing.assert_array_equal(router.results[f"burst{i}"],
+                                      oracle, err_msg=f"burst{i}")
+    for e in router.events:
+        if e["event"] == "route":
+            assert validate_line(e) == []
+            assert isinstance(e["affinity"], float)
+    # a dead replica's affinity history dies with it — the respawned
+    # successor starts cold instead of attracting stale traffic
+    assert home in router._affinity
+    router._on_replica_down(home, "crash", now=0.0)
+    assert home not in router._affinity
+
+
+def test_router_sticky_off_emits_no_affinity(serving_fixture):
+    """sticky=False keeps the route schema at its load-only shape: no
+    affinity field, no fingerprinting work on submit."""
+    params, cfg = serving_fixture
+    router = Router(make_spawn(params, cfg), n_replicas=2,
+                    request_timeout=None, sticky=False)
+    router.submit(toks(52, t=16), 4, rid="q")
+    router.run(max_wall=120)
+    route = next(e for e in router.events if e["event"] == "route")
+    assert "affinity" not in route
+    assert validate_line(route) == []

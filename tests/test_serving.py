@@ -165,7 +165,8 @@ def test_prefill_chunk_logits_match_contiguous_prefill(params):
     bt = np.zeros((1, table_width(len(table), 4)), np.int32)
     bt[0, :len(table)] = table
     logits, pools = _prefill_chunk(params, pools, tokens, np.int32(0),
-                                   np.int32(14), bt, cfg=CFG)
+                                   np.int32(14), bt, np.int32(0),
+                                   np.int32(0), cfg=CFG)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
 
@@ -953,3 +954,247 @@ def test_paged_read_bytes_per_tick_model(params):
                    + 2 * CFG.kv_heads * bs * 4)
     assert q == p_bytes + CFG.n_layers * touched * per_block_q + rows * 4
     assert q < got                      # int8 sweeps fewer bytes
+
+
+# ------------------------------------ prefix caching (round 19)
+
+
+def test_block_allocator_double_free_rejected():
+    """Satellite: duplicate ids inside ONE free() call used to slip
+    through the membership check (each id individually "allocated"),
+    corrupting the free list. Now the per-call multiplicity is
+    validated against the refcount BEFORE anything mutates."""
+    a = BlockAllocator(8)
+    got = a.alloc(2)
+    with pytest.raises(ValueError, match="over-released"):
+        a.free([got[0], got[0]])
+    # atomic: the failed call mutated nothing — both ids still live
+    assert a.n_allocated == 2 and a.n_free == 5
+    a.free(got)
+    assert a.n_free == a.n_usable
+    # same rule across calls: a second release past refcount 0 raises
+    b = a.alloc(1)
+    a.free(b)
+    with pytest.raises(ValueError):
+        a.free(b)
+
+
+def test_refcount_cold_lru_reclaim_order():
+    """Refcounted sharing + the cold list: released-but-indexed
+    blocks park on an LRU cold list (oldest reclaimed first, index
+    entry dropped at reclaim), acquire() revives them, and a block
+    with a live reference is NEVER reclaimed — the pool exhausts with
+    OutOfBlocks instead."""
+    from shallowspeed_tpu.serving.cache import PrefixIndex
+
+    idx = PrefixIndex(block_size=4)
+    a = BlockAllocator(8, index=idx)          # 7 usable
+    tokens = np.arange(12, dtype=np.int32)    # 3 aligned blocks
+    got = a.alloc(3)
+    assert idx.insert(tokens, got) == 3
+    a.release(got)          # refcount 0 + indexed -> cold, in order
+    assert a.n_cold == 3 and a.n_free == 4 and a.n_live == 0
+    assert a.n_free + a.n_live + a.n_cold == a.n_usable
+    # a cache hit revives the chain from cold
+    assert idx.match(tokens) == got
+    a.acquire([got[1]])
+    assert a.n_cold == 2 and a.n_live == 1 and a.refcount(got[1]) == 1
+    # drain the free list, then force reclaims: OLDEST cold first,
+    # and its index entry vanishes with it
+    a.alloc(4)
+    assert a.alloc(1) == [got[0]] and not idx.has_block(got[0])
+    assert a.cold_reclaims == 1
+    assert a.alloc(1) == [got[2]]
+    assert a.n_cold == 0
+    with pytest.raises(OutOfBlocks):
+        a.alloc(1)          # got[1] is referenced — never reclaimed
+    assert a.refcount(got[1]) == 1
+    # releasing more references than held is rejected atomically
+    with pytest.raises(ValueError):
+        a.release([got[1], got[1]])
+    assert a.refcount(got[1]) == 1
+
+
+def test_prefix_cache_parity_tail_only_and_records(params):
+    """The parity gate: cache-hit streams are token-identical to the
+    oracle at temperature 0 AND under seeded sampling; a fully-shared
+    block-aligned prompt re-prefills only the copied tail block (one
+    chunk with prefill_chunk == block_size); request records carry the
+    v14 prefix_hit_blocks / prefill_skipped_tokens fields."""
+    shared = toks(90, t=32)                   # 4 aligned blocks of 8
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=8, prefix_cache=True)
+    ref = solo(params, shared, 6, temperature=0.0)
+    eng.submit(shared, 6, rid="cold")
+    np.testing.assert_array_equal(eng.run()["cold"], ref)
+    cold_chunks = eng.counters["prefill_chunks"]
+    assert cold_chunks == 4
+    # full-aligned hit under seeded sampling: CoW tail, 1 chunk only
+    ref2 = solo(params, shared, 6, temperature=0.8, seed=5)
+    eng.submit(shared, 6, temperature=0.8, seed=5, rid="hit")
+    np.testing.assert_array_equal(eng.run()["hit"], ref2)
+    assert eng.counters["prefill_chunks"] - cold_chunks == 1
+    rec = next(r for r in eng.request_records if r["id"] == "hit")
+    assert rec["prefix_hit_blocks"] == 4
+    assert rec["prefill_skipped_tokens"] == 31    # all but the CoW tok
+    # divergent tail: leading 3 blocks hit, the rest prefills fresh
+    ext = np.concatenate([shared[:24], toks(91, t=10)])
+    ref3 = solo(params, ext, 6, temperature=0.0)
+    eng.submit(ext, 6, rid="ext")
+    np.testing.assert_array_equal(eng.run()["ext"], ref3)
+    rec = next(r for r in eng.request_records if r["id"] == "ext")
+    assert rec["prefix_hit_blocks"] == 3
+    assert rec["prefill_skipped_tokens"] == 24
+    # drain invariant, extended: live zero, free + cold == usable
+    assert eng.alloc.n_live == 0
+    assert eng.alloc.n_free + eng.alloc.n_cold == eng.alloc.n_usable
+
+
+def test_prefix_cache_mid_run_join_parity(params):
+    """A sharer that joins MID-RUN (while the donor is still
+    decoding) must stream the oracle whether it misses (donor not
+    finished -> nothing donated yet) or hits a prefix some earlier
+    request already sealed."""
+    shared = toks(92, t=24)
+    refs = {"a": solo(params, shared, 8, temperature=0.0),
+            "b": solo(params, shared, 8, temperature=0.9, seed=3),
+            "c": solo(params, shared, 8, temperature=0.0)}
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=8, prefix_cache=True)
+    eng.submit(shared, 8, rid="a")
+    for _ in range(2):                       # a is mid-prefill/decode
+        eng.step()
+    eng.submit(shared, 8, temperature=0.9, seed=3, rid="b")
+    while eng.poll("a")["status"] != "done":
+        eng.step()
+    eng.submit(shared, 8, rid="c")           # after donation: a hit
+    res = eng.run()
+    for k, ref in refs.items():
+        np.testing.assert_array_equal(res[k], ref, err_msg=k)
+    assert eng.counters["prefix_hits"] >= 1  # c at minimum
+
+
+def test_prefix_cache_cow_leaves_shared_block_bit_unchanged(params):
+    """Copy-on-write at the tail: a second request over the SAME
+    fully-aligned prompt copies the tail block and rewrites its own
+    last token in the copy — every byte of the donor's indexed blocks
+    (the shared tail included) is bit-identical afterwards."""
+    shared = toks(93, t=16)                  # 2 aligned blocks
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=8, prefix_cache=True)
+    eng.submit(shared, 4, rid="a")
+    eng.run()
+    matched = eng.prefix.match(shared)
+    assert len(matched) == 2
+    sel = np.asarray(matched, np.int32)
+    snap = [{n: np.asarray(leaf[sel]).copy()
+             for n, leaf in pool.items()} for pool in eng.pools]
+    ref = solo(params, shared, 4, temperature=0.0)
+    eng.submit(shared, 4, rid="b")
+    np.testing.assert_array_equal(eng.run()["b"], ref)
+    for pool, before in zip(eng.pools, snap):
+        for n, leaf in pool.items():
+            np.testing.assert_array_equal(
+                np.asarray(leaf[sel]), before[n],
+                err_msg=f"{n}: CoW consumer mutated a shared block")
+
+
+def test_prefix_cache_oom_evict_requeue_shared(params):
+    """Preemption under sharing: a pool too small for the concurrent
+    set forces evictions mid-flight; evicted requests drop their
+    references, re-probe the index on re-admission, and every stream
+    still matches its solo oracle. The allocator balances at drain
+    under the extended invariant."""
+    shared = toks(94, t=16)
+
+    def mk(i):
+        return np.concatenate([shared, toks(100 + i, t=6)])
+
+    oracle = {f"r{i}": solo(params, mk(i), 12, temperature=0.0)
+              for i in range(3)}
+    # 9 usable blocks * 8 = 72 positions < 3 * blocks_for(33) * 8
+    eng = ServingEngine(params, CFG, n_blocks=10, block_size=8,
+                        max_slots=4, prefill_chunk=8, prefix_cache=True)
+    for i in range(3):
+        eng.submit(mk(i), 12, rid=f"r{i}")
+    res = eng.run()
+    for k, ref in oracle.items():
+        np.testing.assert_array_equal(res[k], ref, err_msg=k)
+    assert eng.counters["preempted"] >= 1, "pool never pressured"
+    assert eng.alloc.n_live == 0
+    assert eng.alloc.n_free + eng.alloc.n_cold == eng.alloc.n_usable
+
+
+def test_prefix_cache_zero_new_executables(params):
+    """The hit path (prefix map-in + CoW copy + short tail prefill)
+    is DATA through programs that already executed cold: after a
+    prefix-OFF warmup over the same shapes, serving hits with the
+    cache on compiles nothing new."""
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=8)
+    eng.submit(toks(95, t=16), 6, rid="w")
+    eng.run()
+    warm = eng.executable_counts()
+    eng2 = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                         max_slots=4, prefill_chunk=8,
+                         prefix_cache=True)
+    eng2.submit(toks(95, t=16), 6, rid="a")
+    eng2.run()
+    eng2.submit(toks(95, t=16), 6, rid="b")   # full-aligned CoW hit
+    eng2.run()
+    assert eng2.counters["prefix_hits"] == 1
+    assert eng2.executable_counts() == warm, (
+        f"prefix caching recompiled: {warm} -> "
+        f"{eng2.executable_counts()}")
+
+
+def test_prefix_telemetry_schema_v14_and_status_surface(params,
+                                                        tmp_path):
+    """Prefix-cache telemetry rides the monitor plane: request lines
+    carry prefix_hit_blocks / prefill_skipped_tokens, generate lines
+    the windowed prefix_hit_rate + cold/indexed gauges (all schema-
+    v14-valid), and the monitor surfaces them in /status.json's
+    serving block and /metrics."""
+    from shallowspeed_tpu.metrics import MetricsLogger
+    from shallowspeed_tpu.telemetry import schema
+    from shallowspeed_tpu.telemetry.monitor import Monitor
+
+    assert schema.SCHEMA_VERSION >= 14
+    path = tmp_path / "prefix.jsonl"
+    eng = ServingEngine(params, CFG, n_blocks=32, block_size=8,
+                        max_slots=4, prefill_chunk=8,
+                        prefix_cache=True,
+                        metrics=MetricsLogger(path, kind="serve"),
+                        log_every=2)
+    shared = toks(96, t=16)
+    eng.submit(shared, 6, rid="a")
+    eng.run()
+    eng.submit(shared, 6, rid="b")
+    eng.run()
+    assert schema.validate_file(path) == []
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    hit = next(r for r in recs if r.get("event") == "request"
+               and r.get("id") == "b")
+    assert hit["prefix_hit_blocks"] == 2
+    assert hit["prefill_skipped_tokens"] == 15
+    gens = [r for r in recs if r.get("event") == "generate"]
+    assert gens and all("prefix_hit_rate" in g and "cold_blocks" in g
+                        and "prefix_blocks" in g for g in gens)
+    # the prefill_cached lifecycle phase stamps the hit at admission
+    lcs = [r for r in recs if r.get("event") == "lifecycle"
+           and r.get("phase") == "prefill_cached"]
+    assert lcs and lcs[0]["blocks"] == 2 and lcs[0]["tokens"] == 15
+    mon = Monitor()
+    for r in recs:
+        mon.note_line(r)
+    srv = mon.status()["serving"]
+    assert "prefix_hit_rate" in srv and "cold_blocks" in srv
+    prom = mon.prometheus()
+    assert "prefix_hit_rate" in prom and "prefix_blocks" in prom
+    # malformed prefix fields are rejected
+    assert schema.validate_line(
+        {"event": "request", "id": "x", "ttft_ms": 1.0, "tokens_in": 1,
+         "tokens_out": 1, "prefix_hit_blocks": "many"}) != []
+    assert schema.validate_line(
+        {"event": "generate", "tokens_per_sec": 1.0,
+         "prefix_hit_rate": "high"}) != []

@@ -724,3 +724,45 @@ def test_trace_stitch_cross_process_drill(tmp_path):
     # route/failover lines validate with the v11 fields
     for e in (fo,):
         assert validate_line(e) == []
+
+
+# --------------------------- prefill_cached phase (round 19)
+
+
+def test_prefill_cached_phase_component_and_timeline():
+    """Satellite: the v14 `prefill_cached` lifecycle phase (stamped
+    when the prefix cache maps shared blocks in at admission) books
+    into rq_prefill — the waterfall keeps closing with cache hits in
+    the stream — and `request_timeline` carries the stamp's
+    blocks/tokens payload plus a per-request skipped_tokens total."""
+    assert tracing.PHASE_COMPONENT["prefill_cached"] == "rq_prefill"
+    recs = [
+        {"event": "lifecycle", "id": "q", "phase": "submit", "seq": 0,
+         "attempt": 0, "wall": 50.0},
+        {"event": "lifecycle", "id": "q", "phase": "admitted", "seq": 1,
+         "attempt": 0, "wall": 50.001, "prev": "submit",
+         "ms_in_prev": 1.0},
+        {"event": "lifecycle", "id": "q", "phase": "prefill_cached",
+         "seq": 2, "attempt": 0, "wall": 50.0012, "prev": "admitted",
+         "ms_in_prev": 0.2, "blocks": 3, "tokens": 48},
+        {"event": "lifecycle", "id": "q", "phase": "decoding", "seq": 3,
+         "attempt": 0, "wall": 50.003, "prev": "prefill_cached",
+         "ms_in_prev": 1.8},
+        {"event": "lifecycle", "id": "q", "phase": "finished", "seq": 4,
+         "attempt": 0, "wall": 50.013, "prev": "decoding",
+         "ms_in_prev": 10.0},
+    ]
+    for r in recs:
+        assert validate_line(r) == [], r
+    tl = request_timeline(recs)["q"]
+    assert tl["complete"] and tl["attempts"] == 1
+    cached = next(p for p in tl["phases"]
+                  if p["phase"] == "prefill_cached")
+    assert cached["blocks"] == 3 and cached["tokens"] == 48
+    assert tl["skipped_tokens"] == 48
+    # time spent IN the cached-admission phase books to prefill
+    assert tl["by_phase_ms"]["prefill_cached"] == pytest.approx(1.8)
+    # a timeline with no cache hit reports zero skipped, not a miss
+    plain = [r for r in recs if r["phase"] != "prefill_cached"]
+    plain[2]["prev"] = "admitted"
+    assert request_timeline(plain)["q"]["skipped_tokens"] == 0
