@@ -821,8 +821,17 @@ def bench_serving() -> dict:
                             + (b["wall"] - a["wall"]) * 1e3
                 for c, v in by.items():
                     comp_ms[c].append(v)
+            # capacity accounting (round 20, the memory observatory):
+            # generated tokens per PEAK live KV block — how much decode
+            # work each resident block bought at this offered load. A
+            # drop with tok/s flat means residency grew (blocks pinned
+            # longer or admission overcommitting), which throughput
+            # alone cannot see.
+            peak_blk = max(1, eng.alloc.peak_live)
             out = {"offered": n, "wall_s": round(wall, 3),
                    "tok_per_sec": round(toks / wall, 2),
+                   "peak_live_blocks": eng.alloc.peak_live,
+                   "tok_per_blk": round(toks / peak_blk, 3),
                    "ttft_p50_ms": round(p50("ttft_ms"), 2),
                    "tpot_p50_ms": round(p50("tpot_ms"), 2),
                    "prefill_p50_ms": round(
@@ -861,6 +870,10 @@ def bench_serving() -> dict:
                                  "prefill_chunk": 32, "spec_k": 4},
                 "serving_tok_per_sec": max(lv["tok_per_sec"]
                                            for lv in levels),
+                # capacity headline for --regress (round 20): best
+                # spec-off tokens-per-peak-live-block across levels
+                "serving_capacity_tok_per_blk": max(
+                    lv["tok_per_blk"] for lv in levels),
                 "serving_spec_tok_per_sec": max(
                     lv["tok_per_sec"] for lv in spec_levels),
                 "serving_spec_accept_rate": round(

@@ -293,6 +293,16 @@ def main(argv=None) -> int:
               flush=True)
     if mon is not None and args.shed_load:
         mon.alert_listeners.append(eng.on_alert)
+    if mon is not None:
+        # memory observatory (round 20): block exhaustion trips a full
+        # forensic flight dump — per-owner HBM bytes, top arrays, the
+        # allocator snapshot, block-table widths, the in-flight set.
+        # The listener fires BEFORE the engine stamps its oom ledger
+        # line, so this rich payload wins the flight recorder's
+        # (reason="oom", step=tick) dedup over the bare ledger trigger.
+        eng.oom_listeners.append(
+            lambda en, exc: mon.memory_flight_dump(
+                en.oom_forensics(exc), step=en.counters["ticks"]))
     # continuous profiling plane (round 17): the always-on host stack
     # sampler streams schema-v12 "profile" snapshots into the same
     # metrics JSONL, and critical SLO burns / chaos fault stamps /

@@ -42,7 +42,25 @@ SCRATCH_BLOCK = 0
 class OutOfBlocks(RuntimeError):
     """The free list is empty. The scheduler's preemption policy (evict
     the newest running request, re-queue it with its blocks freed)
-    catches this; it never escapes a `ServingEngine.step`."""
+    catches this; it never escapes a `ServingEngine.step`.
+
+    Typed payload (round 20, the memory observatory): handlers and
+    forensics read `requested`/`n_free`/`n_cold`/`n_live`/`rid`
+    directly instead of string-matching the message. The message keeps
+    its historical "need N blocks, F free + C cold" shape."""
+
+    def __init__(self, requested: int, n_free: int = 0, n_cold: int = 0,
+                 n_live: int = 0, rid=None):
+        self.requested = int(requested)
+        self.n_free = int(n_free)
+        self.n_cold = int(n_cold)
+        self.n_live = int(n_live)
+        self.rid = rid
+        msg = (f"need {self.requested} blocks, {self.n_free} free + "
+               f"{self.n_cold} cold")
+        if rid is not None:
+            msg += f" (request {rid!r})"
+        super().__init__(msg)
 
 
 def blocks_for(n_tokens: int, block_size: int) -> int:
@@ -112,6 +130,9 @@ class BlockAllocator:
         self._cold: dict[int, None] = {}
         self.index = index
         self.cold_reclaims = 0
+        # high-water of n_live over the allocator's lifetime (round 20
+        # capacity accounting: tokens-per-peak-live-block in bench)
+        self.peak_live = 0
 
     @property
     def n_usable(self) -> int:
@@ -135,23 +156,26 @@ class BlockAllocator:
     def refcount(self, bid: int) -> int:
         return self._ref.get(bid, 0)
 
-    def alloc(self, n: int) -> list[int]:
+    def alloc(self, n: int, rid=None) -> list[int]:
         """Mint `n` fresh blocks at refcount 1, or raise OutOfBlocks
         WITHOUT partial allocation (all-or-nothing, so a failed
         admission never leaks). Under pressure, cold cached blocks are
         reclaimed LRU-first (their index entries dropped) before the
-        raise — referenced blocks are never touched."""
+        raise — referenced blocks are never touched. `rid` (the
+        requesting request id, when the caller has one) rides the
+        typed OutOfBlocks payload into the OOM forensics."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free) + len(self._cold):
-            raise OutOfBlocks(
-                f"need {n} blocks, {len(self._free)} free + "
-                f"{len(self._cold)} cold")
+            raise OutOfBlocks(n, n_free=len(self._free),
+                              n_cold=len(self._cold),
+                              n_live=len(self._ref), rid=rid)
         while len(self._free) < n:
             self._reclaim_one()
         ids = [self._free.pop() for _ in range(n)]
         for i in ids:
             self._ref[i] = 1
+        self.peak_live = max(self.peak_live, len(self._ref))
         return ids
 
     def _reclaim_one(self) -> None:
@@ -173,6 +197,7 @@ class BlockAllocator:
         for i in ids:
             self._cold.pop(i, None)
             self._ref[i] = self._ref.get(i, 0) + 1
+        self.peak_live = max(self.peak_live, len(self._ref))
 
     def release(self, ids) -> None:
         """Drop one reference per listed id. At refcount zero the block
@@ -200,6 +225,18 @@ class BlockAllocator:
 
     # `free` kept as the historical name for dropping ownership
     free = release
+
+    def snapshot(self) -> dict:
+        """Point-in-time occupancy for the capacity timeline and OOM
+        forensics. `consistent` restates the allocator invariant
+        (n_free + n_live + n_cold == n_usable) so a dump self-reports
+        bookkeeping corruption."""
+        return {"n_blocks": self.n_blocks, "n_usable": self.n_usable,
+                "n_free": self.n_free, "n_live": self.n_live,
+                "n_cold": self.n_cold, "peak_live": self.peak_live,
+                "cold_reclaims": self.cold_reclaims,
+                "consistent": (self.n_free + self.n_live + self.n_cold
+                               == self.n_usable)}
 
 
 def chunk_hashes(tokens, block_size: int) -> list[bytes]:

@@ -91,6 +91,7 @@ stream-stability contract for the ~1e-6 numerics caveat).
 from __future__ import annotations
 
 import time
+import weakref
 from collections import deque
 from functools import partial
 
@@ -456,7 +457,34 @@ class ServingEngine:
                          "ticks": 0, "prefill_chunks": 0,
                          "shed_toggles": 0, "spec_drafted": 0,
                          "spec_accepted": 0, "prefix_lookups": 0,
-                         "prefix_hits": 0, "prefix_skipped_tokens": 0}
+                         "prefix_hits": 0, "prefix_skipped_tokens": 0,
+                         "oom_events": 0}
+        # OOM forensics (round 20, the memory observatory): every
+        # RECOVERED OutOfBlocks stamps a typed `oom` ledger line and
+        # notifies these listeners with (engine, exc) — serve.py wires
+        # the monitor's memory flight dump here (same hook pattern as
+        # `on_alert`). Throttled to once per tick: one blocked admit
+        # retrying every tick must not flood the ledger.
+        self.oom_listeners: list = []
+        self._oom_tick = -1
+        # ownership registry: the observatory decomposes live HBM by
+        # owner. Weakref'd resolvers — registration must not extend
+        # this engine's (or its donated pools') lifetime; the LAST
+        # engine constructed in a process owns the names (the
+        # one-engine-per-process serving deployment; in-process
+        # multi-engine tests re-register or ignore).
+        from shallowspeed_tpu.telemetry import memory as _memlib
+
+        ref = weakref.ref(self)
+
+        def _own(attr):
+            def resolve():
+                e = ref()
+                return getattr(e, attr) if e is not None else None
+            return resolve
+
+        _memlib.register_owner("serving.params", _own("params"))
+        _memlib.register_owner("serving.kv_pools", _own("pools"))
         # SLO load shedding (round 12, telemetry/monitor): while
         # `admission_paused`, `_admit` leaves the queue alone — running
         # requests keep every slot/block they hold and drain the
@@ -725,6 +753,74 @@ class ServingEngine:
                              slo=sorted(self._critical_slos)[0]
                              if want else slo)
 
+    def headroom(self) -> dict:
+        """The capacity plane's admission-headroom estimate: blocks
+        still needed to finish EVERY accepted request (queued and
+        running) at its max-token budget, vs what the pool can
+        surrender (free + reclaimable cold). Negative headroom means
+        the accepted work is overcommitted — evictions are coming
+        unless requests finish early — which is the router's
+        shed-before-evict placement signal. Uses submit()'s footprint
+        model (tp + max_new - 1 cache positions), so a request's
+        deficit falls as its table grows."""
+        needed = 0
+        for r in self._all_live():
+            final = blocks_for(r.prompt.shape[0] + r.max_new - 1,
+                               self.block_size)
+            needed += max(0, final - len(r.table))
+        return {"live_blocks": self.alloc.n_live,
+                "blocks_needed": needed,
+                "headroom_blocks": (self.alloc.n_free
+                                    + self.alloc.n_cold - needed)}
+
+    def _note_oom(self, e: OutOfBlocks) -> None:
+        """Record one RECOVERED block-exhaustion event: bump the
+        counter, notify the forensics listeners, stamp the typed `oom`
+        ledger line. Throttled to once per tick — a blocked queue
+        retrying every tick is ONE pressure episode, not a stamp per
+        retry. Listeners run FIRST so the rich forensic payload (per-
+        owner bytes, allocator snapshot) wins the flight recorder's
+        (reason, step) dedup over the bare ledger line's trigger."""
+        tick = self.counters["ticks"]
+        if tick == self._oom_tick:
+            return
+        self._oom_tick = tick
+        self.counters["oom_events"] += 1
+        for fn in list(self.oom_listeners):
+            try:
+                fn(self, e)
+            except Exception:
+                pass  # a broken listener must not kill the scheduler
+        if self.metrics is not None:
+            extra = {"id": str(e.rid)} if e.rid is not None else {}
+            self.metrics.log(event="ledger", kind="oom", tick=tick,
+                             requested=e.requested, free=e.n_free,
+                             cold=e.n_cold, live=e.n_live, **extra)
+
+    def oom_forensics(self, e: OutOfBlocks | None = None,
+                      top_k: int = 8) -> dict:
+        """The memory flight-dump payload for this engine: the
+        process-wide per-owner decomposition, top-K largest live
+        arrays, backend allocator stats and host RSS
+        (`telemetry/memory.forensics`) joined with the block
+        allocator's snapshot, the headroom estimate, per-request
+        block-table widths, and the in-flight request set. Host-side
+        only — allocates no device memory, safe inside an OOM
+        handler."""
+        from shallowspeed_tpu.telemetry import memory as memlib
+
+        out = memlib.forensics(top_k)
+        out["allocator"] = self.alloc.snapshot()
+        out["headroom"] = self.headroom()
+        out["block_tables"] = {r.rid: len(r.table)
+                               for r in self.slots if r is not None}
+        out["in_flight"] = [r.rid for r in self._all_live()]
+        if e is not None:
+            out["oom"] = {"requested": e.requested, "free": e.n_free,
+                          "cold": e.n_cold, "live": e.n_live,
+                          "rid": e.rid}
+        return out
+
     def _admit(self) -> bool:
         did = False
         if self.admission_paused and any(s is not None
@@ -755,12 +851,14 @@ class ServingEngine:
                 if matched:
                     self.alloc.acquire(matched)
                 try:
-                    fresh = self.alloc.alloc(need - m + (1 if full else 0))
+                    fresh = self.alloc.alloc(need - m + (1 if full else 0),
+                                             rid=req.rid)
                 except OutOfBlocks:
                     if matched:          # all-or-nothing admission
                         self.alloc.release(matched)
                     raise
-            except OutOfBlocks:
+            except OutOfBlocks as e:
+                self._note_oom(e)
                 break                # wait for blocks to free
             self.queue.popleft()
             slot = self.slots.index(None)
@@ -1024,8 +1122,9 @@ class ServingEngine:
                           self.block_size) - len(req.table)
         if grow > 0:
             try:
-                req.table.extend(self.alloc.alloc(grow))
-            except OutOfBlocks:
+                req.table.extend(self.alloc.alloc(grow, rid=req.rid))
+            except OutOfBlocks as e:
+                self._note_oom(e)
                 cap = len(req.table) * self.block_size - 1 - req.written
                 d = d[:max(0, cap)]
         return d
@@ -1037,8 +1136,9 @@ class ServingEngine:
         while req.written // self.block_size >= len(req.table):
             try:
                 with phase_tag("block-alloc"):
-                    req.table.extend(self.alloc.alloc(1))
-            except OutOfBlocks:
+                    req.table.extend(self.alloc.alloc(1, rid=req.rid))
+            except OutOfBlocks as e:
+                self._note_oom(e)
                 live = [r for r in self.slots if r is not None]
                 victim = max(live, key=lambda r: r.admit_seq)
                 if victim is req and len(live) == 1:
@@ -1173,6 +1273,7 @@ class ServingEngine:
                 if self._win_prefix_lookups else 0.0,
                 cold_blocks=self.alloc.n_cold,
                 prefix_blocks=len(self.prefix))
+        hr = self.headroom()      # schema v15: capacity-plane gauges
         self.metrics.log(
             event="generate",
             tokens_per_sec=round(self._win_tokens / dt, 2),
@@ -1182,6 +1283,9 @@ class ServingEngine:
             blocks_touched=self._last_touched,
             bytes_per_tick=int(bpt),
             hbm_gbps=round(ticks_per_sec * bpt / 1e9, 4),
+            live_blocks=hr["live_blocks"],
+            blocks_needed=hr["blocks_needed"],
+            headroom_blocks=hr["headroom_blocks"],
             **extra)
         self._win_tokens = 0
         self._win_drafted = 0

@@ -459,10 +459,15 @@ class InProcessReplica:
         if not self.proc_alive or self.engine is None:
             return {}
         eng = self.engine
-        return {"queue_depth": len(eng.queue),
-                "active_slots": sum(1 for s in eng.slots
-                                    if s is not None),
-                "free_blocks": eng.alloc.n_free}
+        out = {"queue_depth": len(eng.queue),
+               "active_slots": sum(1 for s in eng.slots
+                                   if s is not None),
+               "free_blocks": eng.alloc.n_free}
+        # v15 capacity plane: the live admission-headroom estimate —
+        # same fields the subprocess path reads off the fleet
+        # collector's serving view
+        out.update(eng.headroom())
+        return out
 
 
 class ReplicaProc:
@@ -1159,6 +1164,16 @@ class Router:
         fb = t.get("free_blocks")
         if isinstance(fb, (int, float)):
             s -= 0.001 * min(float(fb), 1000.0)
+        # v15 capacity plane: NEGATIVE admission headroom means the
+        # replica's accepted max-token budgets already overcommit its
+        # block pool — placing more work there buys evictions, not
+        # throughput. One overcommitted block outweighs one queued
+        # request so a near-OOM replica sheds load BEFORE it evicts;
+        # capped like the ttft penalty so a deeply-overcommitted
+        # replica still ranks (it may be the only one alive).
+        hb = t.get("headroom_blocks")
+        if isinstance(hb, (int, float)) and hb < 0:
+            s += min(-float(hb), 20.0)
         ttft = t.get("ttft_p50_ms")
         if isinstance(ttft, (int, float)) and ttft > 0:
             s += min(float(ttft) / 1e3, 10.0)    # seconds of p50 ttft

@@ -18,7 +18,11 @@ makes the *inside* of a step visible without xprof:
                  joined with measured step time at log points.
 - `memory`       live HBM high-water via `jax.live_arrays()` / device
                  memory stats, cross-checked against the static
-                 prediction `analysis/rules.py`'s memory rule uses.
+                 prediction `analysis/rules.py`'s memory rule uses;
+                 round 20 adds the memory observatory: an ownership
+                 registry (`register_owner`) decomposing resident bytes
+                 per owner, host RSS, `forensics()` OOM dumps, and the
+                 `MemoryWatch` leak/drift detector.
 - `report`       `RunTelemetry`: the driver-facing aggregator that
                  turns all of the above plus retrace/recompile counters
                  into per-step-line fields.
@@ -65,6 +69,13 @@ _LAZY = {
     "two_point_bubble": "bubble",
     "collective_traffic": "collectives",
     "device_memory_stats": "memory", "live_hbm_high_water": "memory",
+    # memory observatory (round 20): per-owner HBM accounting, host
+    # RSS, OOM forensics, leak/drift watch
+    "register_owner": "memory", "unregister_owner": "memory",
+    "clear_owners": "memory", "registered_owners": "memory",
+    "per_owner_accounting": "memory", "top_live_arrays": "memory",
+    "host_rss_bytes": "memory", "forensics": "memory",
+    "MemoryWatch": "memory",
     "RunTelemetry": "report",
     # training health (round 7): on-device numerics pack + host monitor
     "HealthMonitor": "health", "grad_health": "health",

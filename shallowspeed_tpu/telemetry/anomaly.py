@@ -106,6 +106,13 @@ class GuardPolicy:
     # a verdict that repeats AFTER the fallback to abort.
     parity_drift: str = "warn"
     scale_collapse: str = "warn"
+    # memory-observatory kinds (round 20, telemetry/memory.MemoryWatch):
+    # sustained resident-bytes growth and a z-spike step change. Warn
+    # in every mode — memory anomalies are diagnosed from the flight
+    # dump, not auto-actioned: skipping a step frees nothing, and a
+    # bf16 fallback would RAISE residency.
+    mem_leak: str = "warn"
+    mem_drift: str = "warn"
 
     def action(self, kind: str) -> str:
         act = getattr(self, kind, "warn")

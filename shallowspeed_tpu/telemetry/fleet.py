@@ -253,6 +253,7 @@ class Replica:
             "last_step": st.get("last_step"),
             "serving": st.get("serving"),
             "numerics": st.get("numerics"),
+            "memory": st.get("memory"),
             "alerts": st.get("alerts") or [],
             "quantiles": {name: {"count": sk.n,
                                  "p50": sk.quantile(50),
@@ -676,6 +677,9 @@ class FleetCollector:
         numerics = self._numerics_locked(names)
         if numerics:
             out["numerics"] = numerics
+        memory = self._memory_locked(names)
+        if memory:
+            out["memory"] = memory
         if skipped:
             out["fleet"]["skipped_mixed_rel_err"] = skipped
         if self.flight is not None:
@@ -740,6 +744,42 @@ class FleetCollector:
                 name for name, num in per.items()
                 if num.get("num_precision") == "bf16"),
         }
+
+    def _memory_locked(self, names: dict) -> dict | None:
+        """The fleet's memory digest (round 20): worst — MINIMUM —
+        admission headroom across replicas (named, so the near-OOM
+        replica is one read away; the router's placement scoring reads
+        the same per-replica serving views), per-replica memory
+        observatory snapshots, and the roster of replicas that have
+        already recovered a block-exhaustion event."""
+        per = {}
+        heads = {}
+        for rep in self.replicas:
+            st = rep._status or {}
+            mem = st.get("memory")
+            if isinstance(mem, dict) and mem:
+                per[names[rep.uid]] = mem
+            sv = st.get("serving")
+            if isinstance(sv, dict):
+                hb = sv.get("headroom_blocks")
+                if isinstance(hb, (int, float)) \
+                        and not isinstance(hb, bool):
+                    heads[names[rep.uid]] = hb
+        if not per and not heads:
+            return None
+        out: dict = {}
+        if per:
+            out["replicas"] = per
+        if heads:
+            worst = min(heads.items(), key=lambda kv: kv[1])
+            out["headroom_blocks"] = heads
+            out["worst_headroom"] = {"replica": worst[0],
+                                     "value": worst[1]}
+        oomed = sorted(name for name, mem in per.items()
+                       if mem.get("last_oom"))
+        if oomed:
+            out["oom_recovered"] = oomed
+        return out
 
     def _slowest_request(self, names: dict) -> dict | None:
         worst = None
@@ -904,6 +944,18 @@ def format_fleet_status(status: dict) -> str:
         if num.get("fell_back_bf16"):
             lines.append(f"  FELL BACK to bf16: "
                          f"{', '.join(num['fell_back_bf16'])}")
+    mem = status.get("memory")
+    if mem:
+        wh = mem.get("worst_headroom")
+        bits = ["memory:"]
+        if wh:
+            bits.append(f"worst headroom {wh['value']} blocks "
+                        f"@ {wh['replica']}")
+        if mem.get("oom_recovered"):
+            bits.append(f"OOM recovered: "
+                        f"{', '.join(mem['oom_recovered'])}")
+        if len(bits) > 1:
+            lines.append("  " + "  ".join(bits))
     return "\n".join(lines)
 
 

@@ -357,6 +357,11 @@ def run_goodput(path, extra_paths=()) -> dict:
         # shared-block mappings skipped, and the last tick's cold-list
         # / index gauges
         "prefix": _prefix_block(recs, request_recs),
+        # None without schema-v15 memory fields — the memory
+        # observatory's run story: worst headroom the capacity plane
+        # saw, recovered OOM events, the final per-owner
+        # decomposition, and any leak/drift verdicts fired
+        "memory": _memory_block(recs),
     }
 
 
@@ -395,6 +400,56 @@ def _prefix_block(recs, request_recs) -> dict | None:
                                 if isinstance(last.get("prefix_blocks"),
                                               int) else None)
     return out
+
+
+def _memory_block(recs) -> dict | None:
+    """Reduce schema-v15 memory fields to the run's memory story:
+    the capacity plane's worst (minimum) admission headroom across
+    "generate" ticks, the recovered-OOM ledger tally, the last step's
+    per-owner decomposition + untracked residual, peak host RSS, and
+    every mem_leak/mem_drift verdict fired. None when the run carries
+    no memory-observatory fields at all."""
+    gens = [r for r in recs if r.get("event") == "generate"
+            and isinstance(r.get("headroom_blocks"), int)
+            and not isinstance(r.get("headroom_blocks"), bool)]
+    ooms = [r for r in recs if r.get("event") == "ledger"
+            and r.get("kind") == "oom"]
+    steps = [r for r in recs if r.get("event") == "step"
+             and ("hbm_owned_mib" in r or "host_rss_mib" in r
+                  or "mem_verdicts" in r)]
+    if not gens and not ooms and not steps:
+        return None
+    out: dict = {}
+    if gens:
+        worst = min(gens, key=lambda r: r["headroom_blocks"])
+        out["worst_headroom_blocks"] = int(worst["headroom_blocks"])
+        last = gens[-1]
+        out["final_headroom_blocks"] = int(last["headroom_blocks"])
+        if isinstance(last.get("live_blocks"), int):
+            out["final_live_blocks"] = last["live_blocks"]
+    if ooms:
+        out["oom_events"] = len(ooms)
+        worst_oom = max(ooms,
+                        key=lambda r: int(r.get("requested") or 0))
+        out["worst_oom"] = {
+            k: worst_oom[k] for k in ("requested", "free", "cold",
+                                      "live", "id", "tick")
+            if k in worst_oom}
+    if steps:
+        last = steps[-1]
+        if isinstance(last.get("hbm_owned_mib"), dict):
+            out["owners_mib"] = last["hbm_owned_mib"]
+        if isinstance(last.get("hbm_untracked_mib"), (int, float)):
+            out["untracked_mib"] = last["hbm_untracked_mib"]
+        rss = [r["host_rss_mib"] for r in steps
+               if isinstance(r.get("host_rss_mib"), (int, float))]
+        if rss:
+            out["peak_host_rss_mib"] = round(max(rss), 2)
+        verdicts = [v for r in steps
+                    for v in (r.get("mem_verdicts") or [])]
+        if verdicts:
+            out["verdicts"] = [str(v) for v in verdicts]
+    return out or None
 
 
 def _numerics_block(recs) -> dict | None:
@@ -697,6 +752,32 @@ def format_report(rep: dict) -> str:
             + (f" ({sf:.0%} of prompt tokens)" if sf is not None else "")
             + (f", {pfx['cold_blocks']} cold block(s)"
                if pfx.get("cold_blocks") is not None else ""))
+    mem = rep.get("memory")
+    if mem:
+        bits = []
+        if mem.get("worst_headroom_blocks") is not None:
+            bits.append(f"worst headroom "
+                        f"{mem['worst_headroom_blocks']} blocks")
+        if mem.get("oom_events"):
+            oo = mem.get("worst_oom") or {}
+            bits.append(
+                f"{mem['oom_events']} recovered OOM(s)"
+                + (f" (worst: need {oo['requested']}, "
+                   f"{oo.get('free', 0)} free + {oo.get('cold', 0)} "
+                   f"cold)" if "requested" in oo else ""))
+        if mem.get("untracked_mib") is not None:
+            bits.append(f"untracked {mem['untracked_mib']} MiB")
+        if mem.get("peak_host_rss_mib") is not None:
+            bits.append(f"host rss peak {mem['peak_host_rss_mib']} MiB")
+        if bits:
+            lines.append("memory: " + "  ".join(bits))
+        if mem.get("owners_mib"):
+            top = sorted(mem["owners_mib"].items(),
+                         key=lambda kv: -kv[1])[:4]
+            lines.append("  owners: " + "  ".join(
+                f"{k} {v} MiB" for k, v in top))
+        if mem.get("verdicts"):
+            lines.append(f"  MEMORY verdicts: {mem['verdicts']}")
     lc = rep.get("lifecycle")
     if lc:
         top = sorted(lc["by_phase_ms"].items(),

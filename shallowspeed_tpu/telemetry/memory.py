@@ -1,4 +1,6 @@
-"""Live HBM accounting — runtime cross-check of the static memory rule.
+"""Live HBM accounting — runtime cross-check of the static memory rule,
+per-owner decomposition, and leak/drift detection (the memory
+observatory, round 20).
 
 `analysis/rules.py`'s memory-highwater rule predicts a step's
 live-buffer peak from the jaxpr; this module samples what is ACTUALLY
@@ -18,6 +20,24 @@ is deliberately conservative — `walker.peak_bytes` ignores fusion and
 donation). A live sample EXCEEDING static + tolerance means the
 estimator lost track of real buffers — the failure mode the gate
 exists to catch.
+
+The OWNERSHIP REGISTRY decomposes the live total: engines register
+their long-lived pytrees (params, optimizer state, KV block pools,
+amax history, draft buffers) as zero-arg resolvers, and
+`per_owner_accounting()` attributes each live array to the first owner
+whose resolved tree contains it. What no owner claims is the
+`untracked` residual — the leak alarm: a residual that grows across
+windows is memory the process holds but nothing accounts for.
+Resolvers (not pytrees) because the interesting trees ROTATE — pools
+are donated through every compiled tick, optimizer state is replaced
+every step — so a registered snapshot would both pin dead buffers
+alive and go stale within one iteration.
+
+`MemoryWatch` turns the sampled series into `telemetry/anomaly`
+verdicts: `mem_drift` (robust EWMA z-spike in resident device bytes or
+host RSS) and `mem_leak` (sustained growth over `patience` consecutive
+observations — the slope detector a z-score misses because a slow leak
+drags the EWMA mean along with it).
 """
 
 from __future__ import annotations
@@ -81,3 +101,233 @@ def cross_check(live_max: int, static_peak: int,
     return {"live_bytes": int(live_max), "static_bytes": int(static_peak),
             "ratio": round(live_max / max(static_peak, 1), 4),
             "within_bound": bool(ok)}
+
+
+# ------------------------------------------------- ownership registry
+#
+# name -> zero-arg resolver returning a pytree (or None when the owner
+# has nothing resident yet). Module-global on purpose: the registry is
+# observability state like the chaos plan or the metrics monitor, and
+# a driver's engines, pools and optimizer state all live in one
+# process. Resolvers keep it weak — the registry holds no array refs,
+# so registering an owner never extends a buffer's lifetime.
+
+_OWNERS: dict[str, object] = {}
+
+
+def register_owner(name: str, resolve) -> None:
+    """Register (or replace) a memory owner. `resolve` is a zero-arg
+    callable returning the owner's CURRENT pytree of jax.Arrays — it is
+    called fresh at every accounting point, so donated/rotated buffers
+    resolve to their latest incarnation. Return None (or raise) to
+    report nothing this window."""
+    if not callable(resolve):
+        raise TypeError(f"register_owner({name!r}): resolver must be "
+                        f"callable, got {type(resolve).__name__}")
+    _OWNERS[str(name)] = resolve
+
+
+def unregister_owner(name: str) -> None:
+    _OWNERS.pop(str(name), None)
+
+
+def clear_owners() -> None:
+    """Drop every registered owner (test isolation / driver teardown)."""
+    _OWNERS.clear()
+
+
+def registered_owners() -> tuple:
+    return tuple(_OWNERS)
+
+
+def _live_by_id() -> dict[int, "jax.Array"]:
+    """id(arr) -> arr over the live set. Identity (not content) keyed:
+    attribution must match the EXACT objects an owner resolves, and two
+    owners resolving the same array must not double-count it."""
+    out = {}
+    for arr in jax.live_arrays():
+        out[id(arr)] = arr
+    return out
+
+
+def _shard_bytes(arr) -> int:
+    try:
+        return sum(int(sh.data.nbytes) for sh in arr.addressable_shards)
+    except Exception:
+        return 0
+
+
+def per_owner_accounting() -> dict:
+    """Decompose total resident bytes (summed over every live array's
+    addressable shards — the all-device total, not the per-device max)
+    into per-owner contributions plus the unclaimed residual:
+
+        {"owners": {name: bytes}, "tracked_bytes", "untracked_bytes",
+         "live_bytes", "n_live_arrays"}
+
+    Each live array is claimed at most once (first registered owner
+    wins), so `sum(owners.values()) == tracked_bytes <= live_bytes` and
+    `untracked_bytes >= 0` by construction. Leaves an owner resolves
+    that are NOT live (stale references, donated-away buffers) cost 0 —
+    the accounting never invents bytes the process doesn't hold."""
+    live = _live_by_id()
+    live_bytes = sum(_shard_bytes(a) for a in live.values())
+    claimed: set[int] = set()
+    owners: dict[str, int] = {}
+    for name, resolve in _OWNERS.items():
+        try:
+            tree = resolve()
+        except Exception:
+            tree = None
+        total = 0
+        if tree is not None:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                key = id(leaf)
+                if key in live and key not in claimed:
+                    claimed.add(key)
+                    total += _shard_bytes(live[key])
+        owners[name] = total
+    tracked = sum(owners.values())
+    return {"owners": owners, "tracked_bytes": int(tracked),
+            "untracked_bytes": int(live_bytes - tracked),
+            "live_bytes": int(live_bytes), "n_live_arrays": len(live)}
+
+
+def top_live_arrays(k: int = 5) -> list[dict]:
+    """The k largest live arrays — the first thing to read in an OOM
+    dump. Each entry carries shape/dtype/bytes plus the owning
+    registry name ("untracked" when nothing claims it)."""
+    live = _live_by_id()
+    owner_of: dict[int, str] = {}
+    for name, resolve in _OWNERS.items():
+        try:
+            tree = resolve()
+        except Exception:
+            continue
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            owner_of.setdefault(id(leaf), name)
+    rows = []
+    for key, arr in live.items():
+        nb = _shard_bytes(arr)
+        try:
+            shape = list(arr.shape)
+            dtype = str(arr.dtype)
+        except Exception:
+            shape, dtype = None, None
+        rows.append({"shape": shape, "dtype": dtype, "nbytes": nb,
+                     "owner": owner_of.get(key, "untracked")})
+    rows.sort(key=lambda r: r["nbytes"], reverse=True)
+    return rows[:max(0, int(k))]
+
+
+def host_rss_bytes() -> int:
+    """Host resident set size, stdlib-only: /proc/self/status VmRSS
+    where procfs exists (Linux), else getrusage peak (ru_maxrss is KiB
+    on Linux semantics, bytes on macOS — close enough for a trend
+    series; the detector watches deltas, not absolutes). 0 when
+    neither source works."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+        import sys
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss) if sys.platform == "darwin" else int(rss) * 1024
+    except Exception:
+        return 0
+
+
+def forensics(top_k: int = 8) -> dict:
+    """The memory flight-dump payload: per-owner decomposition, the
+    top-k largest live arrays, backend allocator stats, and host RSS —
+    everything host-side, safe to call from an OOM handler (allocates
+    no device memory)."""
+    return {"accounting": per_owner_accounting(),
+            "top_arrays": top_live_arrays(top_k),
+            "device_stats": device_memory_stats(),
+            "host_rss_bytes": host_rss_bytes()}
+
+
+class MemoryWatch:
+    """Steady-state leak/drift detector over resident-bytes series.
+
+    Two complementary detectors per series (device-resident bytes and
+    host RSS, fed by the caller each log window):
+
+    - `mem_drift`: robust EWMA z-spike (`telemetry/anomaly.RobustEWMA`)
+      — a step change in residency (a buffer that should have been
+      freed and wasn't, a recompile that doubled an arena).
+    - `mem_leak`: the slope detector — residency grew by more than
+      `growth_frac` in EACH of `patience` consecutive observations. A
+      slow leak never z-spikes (the EWMA mean tracks it), but it
+      cannot hide from a monotone-growth run.
+
+    Verdicts carry the `telemetry/anomaly` shape, so the monitor's
+    flight-recorder and the GuardPolicy (`mem_leak`/`mem_drift`
+    fields) treat them exactly like training-health verdicts."""
+
+    def __init__(self, spike_z: float = 6.0, patience: int = 6,
+                 growth_frac: float = 0.01, alpha: float = 0.05,
+                 warmup: int = 8):
+        from shallowspeed_tpu.telemetry.anomaly import RobustEWMA
+
+        self.spike_z = float(spike_z)
+        self.patience = int(patience)
+        self.growth_frac = float(growth_frac)
+        self._ewma = {"device": RobustEWMA(alpha, warmup),
+                      "host_rss": RobustEWMA(alpha, warmup)}
+        self._last: dict[str, float] = {}
+        self._runs: dict[str, int] = {}
+        self._leak_reported: set[str] = set()
+
+    def _observe_series(self, step: int, name: str, x: float) -> list:
+        from shallowspeed_tpu.telemetry.anomaly import Verdict
+
+        out = []
+        z = self._ewma[name].update(x)
+        if z is not None and z > self.spike_z:
+            out.append(Verdict(
+                "mem_drift", step,
+                detail=f"{name} resident {x / (1 << 20):.1f} MiB is "
+                       f"{z:.1f} robust sigmas above its EWMA "
+                       f"{self._ewma[name].mean / (1 << 20):.1f} MiB"))
+        last = self._last.get(name)
+        self._last[name] = x
+        if last is not None and last > 0 \
+                and x > last * (1.0 + self.growth_frac):
+            run = self._runs.get(name, 0) + 1
+            self._runs[name] = run
+            if run >= self.patience and name not in self._leak_reported:
+                self._leak_reported.add(name)
+                out.append(Verdict(
+                    "mem_leak", step, severity="error",
+                    detail=f"{name} residency grew >"
+                           f"{self.growth_frac:.1%} per window for "
+                           f"{run} consecutive windows (now "
+                           f"{x / (1 << 20):.1f} MiB)"))
+        elif last is not None:
+            self._runs[name] = 0
+            self._leak_reported.discard(name)
+        return out
+
+    def observe(self, step: int, device_bytes=None,
+                rss_bytes=None) -> list:
+        """Feed one log window's samples; returns anomaly Verdicts
+        (possibly empty). Either series may be None (CPU runs have no
+        allocator stats; tests may feed only one)."""
+        out = []
+        if device_bytes is not None:
+            out.extend(self._observe_series(step, "device",
+                                            float(device_bytes)))
+        if rss_bytes is not None and rss_bytes > 0:
+            out.extend(self._observe_series(step, "host_rss",
+                                            float(rss_bytes)))
+        return out

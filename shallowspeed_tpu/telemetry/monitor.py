@@ -380,6 +380,11 @@ class Monitor:
         # step fields — the live precision story /status.json and
         # /metrics serve next to health, and the fleet view rolls up
         self.numerics: dict = {}
+        # memory observatory (round 20): last-seen schema-v15 memory
+        # step fields (per-owner MiB, untracked residual, host RSS),
+        # the last recovered-OOM ledger stamp, and the last forensic
+        # payload a memory flight dump carried
+        self.memory: dict = {}
         # per-request lifecycle accounting (round 16): in-flight
         # phase-time accumulation keyed by request id, reduced on
         # "finished" into the rq_* component sketches and the
@@ -462,6 +467,7 @@ class Monitor:
         elif rec.get("health_nonfinite"):
             self.health = "warn: nonfinite"
         self._note_numerics(rec)
+        self._note_memory(rec)
         if self.derive_steps:
             step, wall = rec.get("step"), rec.get("wall")
             if isinstance(rec.get("tokens_per_sec"), (int, float)):
@@ -513,7 +519,13 @@ class Monitor:
                       # cold-list/index size ride /status.json so the
                       # fleet view sees whether caching is paying
                       "prefix_hit_rate", "cold_blocks",
-                      "prefix_blocks"):
+                      "prefix_blocks",
+                      # schema v15: capacity-plane gauges — the
+                      # admission-headroom estimate the fleet view and
+                      # router placement read (negative = the replica
+                      # is overcommitted, evictions coming)
+                      "live_blocks", "blocks_needed",
+                      "headroom_blocks"):
             if field in rec:
                 self.serving[field] = rec[field]
         for rule in self.rules:
@@ -573,6 +585,43 @@ class Monitor:
                 for rule in self.rules:
                     if rule.sketch is None:
                         rule.record_down(float(secs), now)
+        if rec.get("kind") == "oom":
+            # schema v15: a recovered OutOfBlocks stamp. Trip the
+            # memory flight dump here too (tailer mode: no engine
+            # listener wired) — in live serve mode the engine's
+            # oom_listeners fired the RICH forensic dump first, so
+            # this one dedups away on the same ("oom", tick) key.
+            self.memory["last_oom"] = {
+                k: rec[k] for k in ("requested", "free", "cold",
+                                    "live", "id", "tick") if k in rec}
+            self._flight_dump("oom", rec.get("tick"), rec)
+
+    def _note_memory(self, rec: dict) -> None:
+        """Fold schema-v15 memory step fields into the live memory
+        view; a MemoryWatch verdict (mem_leak / mem_drift) trips the
+        same incident path as a health verdict — flight dump +
+        profiler capture window."""
+        for field in ("hbm_live_mib", "hbm_owned_mib",
+                      "hbm_untracked_mib", "host_rss_mib",
+                      "hbm_within_bound"):
+            if field in rec and rec[field] is not None:
+                self.memory[field] = rec[field]
+        verdicts = rec.get("mem_verdicts")
+        if verdicts:
+            self.memory["last_verdicts"] = [str(v) for v in verdicts]
+            self.health = "warn: " + ",".join(str(v) for v in verdicts)
+            self._flight_dump("memory:" + ",".join(
+                str(v) for v in verdicts), rec.get("step"), rec)
+
+    def memory_flight_dump(self, payload: dict, step=None) -> None:
+        """OOM-forensics trigger (`ServingEngine.oom_listeners` →
+        here, wired by serve.py): keep the forensic payload on the
+        live memory view and dump it through the flight recorder /
+        profiler capture path. `step` is the engine tick, matching the
+        ledger stamp's dedup key."""
+        with self._lock:
+            self.memory["oom_forensics"] = payload
+            self._flight_dump("oom", step, payload)
 
     def _on_fault(self, rec: dict) -> None:
         self.counters["faults"] += 1
@@ -810,6 +859,10 @@ class Monitor:
                 # v13): live precision, clamp fractions, shadow-parity
                 # rel-errs, and the last verdicts that fired
                 "numerics": self.numerics or None,
+                # the memory observatory's last-seen story (schema
+                # v15): per-owner decomposition, untracked residual,
+                # host RSS, last recovered OOM + forensic payload
+                "memory": self.memory or None,
                 # the slowest finished request's per-component
                 # decomposition (round 16) — where ITS latency went,
                 # one hop from the burning quantile
@@ -853,9 +906,18 @@ class Monitor:
                     lines.append(f"{P}{name} {v:.6g}")
             for field in ("queue_depth", "active_slots", "free_blocks",
                           "spec_accept_rate", "prefix_hit_rate",
-                          "cold_blocks", "prefix_blocks"):
+                          "cold_blocks", "prefix_blocks",
+                          "live_blocks", "blocks_needed",
+                          "headroom_blocks"):
                 v = self.serving.get(field)
                 if isinstance(v, (int, float)):
+                    lines.append(f"# TYPE {P}{field} gauge")
+                    lines.append(f"{P}{field} {v:.6g}")
+            for field in ("hbm_live_mib", "hbm_untracked_mib",
+                          "host_rss_mib"):
+                v = self.memory.get(field)
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
                     lines.append(f"# TYPE {P}{field} gauge")
                     lines.append(f"{P}{field} {v:.6g}")
             for field in ("num_overflow_max", "num_underflow_max",

@@ -67,6 +67,14 @@ METRICS = {
     # flat means prefix caching or sticky routing stopped paying;
     # rounds before r19 lack the metric and pass vacuously
     "prefix_tok_per_sec": (0.35, None),
+    # serving capacity (round 20, the memory observatory): generated
+    # tokens per peak live KV block over bench's serving sweep — how
+    # much decode work each resident block bought. A drop with
+    # serving_tok_per_sec flat means residency grew (blocks pinned
+    # longer, eviction stopped paying, or admission overcommitting);
+    # same dispatch noise as the throughput numbers, so the same wide
+    # floor. Rounds before r20 lack the metric and pass vacuously.
+    "serving_capacity_tok_per_blk": (0.35, None),
 }
 
 

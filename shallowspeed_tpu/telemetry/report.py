@@ -319,6 +319,12 @@ class RunTelemetry:
         # surfaced in run_summary so telemetry.json carries the
         # in-process loss totals next to the waterfall
         self.ledger = None
+        # memory observatory (round 20): per-window leak/drift
+        # detector over the live-bytes + host-RSS series this
+        # telemetry already samples; verdicts ride step lines as
+        # mem_verdicts (schema v15)
+        self.memwatch = memory.MemoryWatch()
+        self._mem_windows = 0
 
     # -------------------------------------------------------- static
 
@@ -416,6 +422,27 @@ class RunTelemetry:
                  if v.get("peak_bytes_in_use")]
         if peaks:
             out["hbm_alloc_peak_mib"] = round(max(peaks) / MiB, 2)
+        # schema v15 (memory observatory): decompose the live total by
+        # registered owner — the untracked residual is the leak alarm
+        # — and feed the leak/drift detector with this window's
+        # device + host-RSS samples
+        if memory.registered_owners():
+            acct = memory.per_owner_accounting()
+            out["hbm_owned_mib"] = {
+                name: round(b / MiB, 2)
+                for name, b in acct["owners"].items()}
+            out["hbm_untracked_mib"] = round(
+                acct["untracked_bytes"] / MiB, 2)
+        rss = memory.host_rss_bytes()
+        if rss:
+            out["host_rss_mib"] = round(rss / MiB, 2)
+        self._mem_windows += 1
+        mem_verdicts = self.memwatch.observe(
+            self._mem_windows,
+            device_bytes=live["max_device_bytes"],
+            rss_bytes=rss or None)
+        if mem_verdicts:
+            out["mem_verdicts"] = [str(v) for v in mem_verdicts]
         static = self.static_report()
         if static is not None:
             step_ep = static["entrypoints"].get(static["step"], {})
@@ -588,6 +615,10 @@ class RunTelemetry:
             if peak:
                 out["hbm_check"] = memory.cross_check(
                     live["max_device_bytes"], peak, self.tol)
+        # the final per-owner decomposition (memory observatory):
+        # telemetry.json carries who held what at the end of the run
+        if memory.registered_owners():
+            out["hbm_owners"] = memory.per_owner_accounting()
         return out
 
     def write_summary(self, trace_dir) -> Path:

@@ -134,12 +134,25 @@ from pathlib import Path
 # "generate" tick lines grow the `prefix_hit_rate` / `cold_blocks` /
 # `prefix_blocks` gauges /status.json + /metrics + the fleet view
 # surface; "route" lines may carry the sticky `affinity` bonus.
+# 15 = v14 plus the memory-observatory extension (round 20,
+# `telemetry/memory.py`): "step" lines may carry the per-owner HBM
+# decomposition (`hbm_owned_mib`: registry-owner name -> resident MiB,
+# `hbm_untracked_mib`: the unclaimed residual — the leak alarm), the
+# host-side series (`host_rss_mib`), and `mem_verdicts` (the drained
+# MemoryWatch mem_leak / mem_drift window, mirroring health_verdicts);
+# "generate" tick lines grow the capacity-plane gauges (`live_blocks`,
+# `blocks_needed` — blocks required to finish every admitted request
+# at its max-token budget — and `headroom_blocks` = free + cold -
+# still-needed, negative when the replica is overcommitted); "ledger"
+# lines allow the `oom` stamp's typed OutOfBlocks payload (requested /
+# free / cold / live block counts + the requester `id`) written at
+# every recovered block-exhaustion event.
 # The validator accepts ALL dialects — every versioned field is
-# optional, so committed v1-v13 artifacts (no version stamp / no
+# optional, so committed v1-v14 artifacts (no version stamp / no
 # health / overlap / attrib / wall / fault / request / monitor /
 # straggler / lifecycle / speculation / routing / tracing / profile /
-# numerics / prefix fields) keep validating unchanged.
-SCHEMA_VERSION = 14
+# numerics / prefix / memory fields) keep validating unchanged.
+SCHEMA_VERSION = 15
 
 _NUM = (int, float)
 
@@ -207,7 +220,12 @@ _METRIC_EVENTS = {
 # and circuit-breaker transitions)
 _LEDGER_OPTIONAL = {"seconds": _NUM, "count": int, "fail_class": str,
                     "width": int, "prev_width": int, "tick": int,
-                    "replica": str, "state": str}
+                    "replica": str, "state": str,
+                    # v15: the `oom` stamp — a recovered OutOfBlocks'
+                    # typed payload (allocator counts at the raise; the
+                    # requester rid rides as `id`)
+                    "requested": int, "free": int, "cold": int,
+                    "live": int, "id": str}
 
 # optional typed fields on a "fault" line
 _FAULT_OPTIONAL = {"step": int, "save": int, "seconds": _NUM,
@@ -239,7 +257,13 @@ _GENERATE_OPTIONAL = {"queue_depth": int, "active_slots": int,
                       "spec_accept_rate": _NUM,
                       # v14: prefix-cache window gauges
                       "prefix_hit_rate": _NUM, "cold_blocks": int,
-                      "prefix_blocks": int}
+                      "prefix_blocks": int,
+                      # v15: capacity-plane gauges (memory
+                      # observatory) — headroom_blocks goes NEGATIVE
+                      # when admitted max-token budgets overcommit the
+                      # pool, which is the shed-before-evict signal
+                      "live_blocks": int, "blocks_needed": int,
+                      "headroom_blocks": int}
 
 # optional typed fields on the schema-v7 events
 _MONITOR_OPTIONAL = {"counters": dict, "rel_err": _NUM}
@@ -324,6 +348,12 @@ _STEP_TELEMETRY = {
     "num_parity_loss_rel": _NUM, "num_parity_grad_relmax": _NUM,
     "num_shadow_total": int, "num_precision": str,
     "num_verdicts": list,
+    # --- schema v15: memory-observatory fields (telemetry/memory.py)
+    # — the per-owner HBM decomposition (owner name -> resident MiB),
+    # the unclaimed residual, host RSS, and the drained MemoryWatch
+    # verdict window
+    "hbm_owned_mib": dict, "hbm_untracked_mib": _NUM,
+    "host_rss_mib": _NUM, "mem_verdicts": list,
 }
 
 # "M" (schema v8): Chrome metadata events — the named per-request

@@ -758,3 +758,43 @@ def test_router_sticky_off_emits_no_affinity(serving_fixture):
     route = next(e for e in router.events if e["event"] == "route")
     assert "affinity" not in route
     assert validate_line(route) == []
+
+
+def test_router_headroom_penalty_deprioritizes_near_oom(serving_fixture):
+    """The v15 capacity plane's placement pin: a replica whose
+    admission headroom is NEGATIVE (accepted max-token budgets already
+    overcommit its block pool) is deprioritized — placing work there
+    buys evictions, not throughput — while positive headroom costs
+    nothing, and the penalty is capped so a deeply-overcommitted
+    replica still ranks when it is the only one alive."""
+    params, cfg = serving_fixture
+    router = Router(make_spawn(params, cfg), n_replicas=2,
+                    request_timeout=None, sticky=False)
+    base = {"queue_depth": 0, "active_slots": 0, "free_blocks": 10}
+    h0 = router._replicas["r0"]["handle"]
+    h1 = router._replicas["r1"]["handle"]
+    h0.telemetry = lambda: dict(base, headroom_blocks=12)
+    h1.telemetry = lambda: dict(base, headroom_blocks=-6)
+    now = router.clock()
+    # each overcommitted block is one full score unit — decisive
+    # against telemetry noise, unlike the 0.001/free-block nudge
+    assert router._score("r1", now) - router._score("r0", now) \
+        == pytest.approx(6.0)
+    # capped at 20: a catastrophically-overcommitted replica is
+    # deprioritized, not unroutable
+    h1.telemetry = lambda: dict(base, headroom_blocks=-10_000)
+    assert router._score("r1", now) - router._score("r0", now) \
+        == pytest.approx(20.0)
+    # placement: the submitted request lands on the healthy replica
+    h1.telemetry = lambda: dict(base, headroom_blocks=-6)
+    router.submit(toks(53, t=8), 4, rid="x")
+    router.step()
+    assert router.inflight["x"].replica == "r0"
+    router.run(max_wall=120)
+    assert "x" in router.results
+    # positive headroom itself is never a tiebreak bonus beyond the
+    # free-blocks nudge: two healthy replicas score identically
+    h1.telemetry = lambda: dict(base, headroom_blocks=2)
+    h0.telemetry = lambda: dict(base, headroom_blocks=900)
+    assert router._score("r0", router.clock()) \
+        == pytest.approx(router._score("r1", router.clock()))

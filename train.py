@@ -563,6 +563,14 @@ def train(args) -> float:
              if args.telemetry != "off" else None)
     if telem is not None:
         telem.ledger = ledger
+        # memory observatory (round 20): register the long-lived trees
+        # so step lines decompose live HBM per owner; resolvers, not
+        # snapshots — the engine rotates/donates these every step
+        from shallowspeed_tpu.telemetry import memory as memlib
+        memlib.register_owner(
+            "train.params", lambda: getattr(engine, "params", None))
+        memlib.register_owner(
+            "train.opt_state", lambda: getattr(engine, "opt_state", None))
 
     # ---- live telemetry plane (telemetry/monitor.py): endpoint +
     # SLO alerts + flight recorder, fed by every metrics line

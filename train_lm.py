@@ -870,6 +870,16 @@ def train(args) -> float:
              if args.telemetry != "off" else None)
     if telem is not None:
         telem.ledger = ledger  # loss totals ride telemetry.json too
+        # memory observatory (round 20): register the long-lived trees
+        # so step lines decompose live HBM per owner (hbm_owned_mib)
+        # with the residual surfaced as hbm_untracked_mib — a growing
+        # residual is the leak alarm. Resolvers, not snapshots: the
+        # engine rotates/donates these trees every step.
+        from shallowspeed_tpu.telemetry import memory as memlib
+        memlib.register_owner(
+            "train.params", lambda: getattr(engine, "params", None))
+        memlib.register_owner(
+            "train.opt_state", lambda: getattr(engine, "opt_state", None))
     # ---- training health (telemetry/health.py): the engines compute
     # the pack on device every step; the monitor fetches it at log
     # points, runs the anomaly detectors, and its fields ride the same
